@@ -1,0 +1,38 @@
+"""multi_cluster_simulator_tpu — a TPU-native multi-cluster scheduling simulator.
+
+A brand-new JAX/XLA framework with the capabilities of the Go reference
+(hamzalsheikh/multi-cluster-simulator): multiple cluster schedulers with
+pluggable policies, cross-cluster borrowing, a priced trader market, service
+discovery with heartbeats, distribution-driven workload generation, and
+metrics/tracing — redesigned TPU-first:
+
+- world state lives in padded int32 tensors (clusters x nodes x resources,
+  clusters x queue-slots x job-fields) instead of mutex-guarded Go structs;
+- time is a discrete virtual clock driven by ``lax.scan`` instead of
+  ``time.Sleep`` (reference: pkg/scheduler/cluster.go:141-161);
+- the per-tick placement decision is a vmapped first-fit kernel over the node
+  axis (reference: pkg/scheduler/scheduler.go:127-139);
+- cross-cluster mechanisms (borrow broadcast, trader offer/accept) are batched
+  array ops that lower to XLA collectives when the cluster axis is sharded
+  over a device mesh (reference: pkg/scheduler/server.go:160-248,
+  pkg/trader/trader.go:193-278).
+"""
+
+from multi_cluster_simulator_tpu.config import SimConfig, TraderConfig, WorkloadConfig
+from multi_cluster_simulator_tpu.core.spec import ClusterSpec, NodeSpec, load_cluster_json
+from multi_cluster_simulator_tpu.core.state import SimState, init_state
+from multi_cluster_simulator_tpu.core.engine import Engine
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SimConfig",
+    "TraderConfig",
+    "WorkloadConfig",
+    "ClusterSpec",
+    "NodeSpec",
+    "load_cluster_json",
+    "SimState",
+    "init_state",
+    "Engine",
+]

@@ -1,0 +1,134 @@
+"""Configuration layer.
+
+The reference hardcodes every operational constant (catalogued in SURVEY.md §5);
+this module lifts them all into dataclasses. Each field cites the reference
+file:line its default value comes from. All times are virtual-time
+milliseconds (int) — the reference's wall-clock durations map 1:1 onto the
+virtual clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+
+class PolicyKind(str, enum.Enum):
+    """Scheduling policy. Reference: SchedulingType, pkg/scheduler/scheduler.go:40-45.
+
+    FFD (first-fit-decreasing bin-pack) is a new TPU-side policy demanded by
+    BASELINE.json config 3; the reference has only FIFO and DELAY.
+    """
+
+    FIFO = "FIFO"
+    DELAY = "DELAY"
+    FFD = "FFD"
+
+
+class MatchKind(str, enum.Enum):
+    """Trader market matching algorithm.
+
+    GREEDY reproduces the reference's cheapest-approving-seller heap
+    (pkg/trader/trader.go:169-191,236-276) deterministically; SINKHORN is the
+    batched optimal-transport upgrade (BASELINE.json config 4).
+    """
+
+    GREEDY = "greedy"
+    SINKHORN = "sinkhorn"
+
+
+@dataclasses.dataclass(frozen=True)
+class TraderConfig:
+    """Per-cluster trader agent knobs. Reference: pkg/trader/trader.go:41-65."""
+
+    enabled: bool = False
+    # approvePolicy (seller side), trader.go:47-52
+    approve_core_threshold: float = 0.8
+    approve_mem_threshold: float = 0.8
+    min_core_incentive: float = -1.0  # per core-second, trader.go:50
+    min_mem_incentive: float = -1.0  # per MB-second, trader.go:51
+    # requestPolicy (buyer side), trader.go:55-62
+    request_max_wait_ms: float = 600_000.0  # requestPolicy_WaitTime, trader.go:57
+    request_core_max: float = 0.8  # requestPolicy_Utilization, trader.go:60-61
+    request_mem_max: float = 0.8
+    # economics, trader.go:53 and (never-initialized, hence 0.0) trader.go:34-35
+    budget: float = -1.0  # negative = unlimited
+    max_core_cost: float = 0.0  # per core-second
+    max_mem_cost: float = 0.0  # per MB-second
+    # cadences
+    monitor_period_ms: int = 10_000  # RequestPolicyMonitor loop, trader.go:323
+    cooldown_success_ms: int = 240_000  # 4 min sleep after success, trader.go:298
+    cooldown_failure_ms: int = 120_000  # 2 min sleep after failure, trader.go:302
+    state_cadence_ms: int = 5_000  # scheduler state stream, trader_server.go:42
+    contract_ttl_ms: int = 20_000  # seller contract validity, trader/server.go:49
+    matching: MatchKind = MatchKind.GREEDY
+    sinkhorn_iters: int = 16
+    # When True, borrowed virtual nodes expire after their contract duration
+    # ("sane" mode). The reference keeps them forever (AddVirtualNode never
+    # removes, pkg/scheduler/cluster.go:65-85), which the False default
+    # reproduces.
+    expire_virtual_nodes: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadConfig:
+    """Workload generator. Reference: pkg/client/client.go:85-147."""
+
+    arrival: str = "poisson"  # "poisson" | "weibull" | "trace"
+    poisson_lambda_per_min: float = 10.0  # client.go:108
+    weibull_lambda_s: float = 10.0  # client.go:133
+    weibull_k: float = 3.0  # client.go:134
+    beta_alpha: float = 2.0  # job-size distribution Beta(2,2), client.go:87-90
+    beta_beta: float = 2.0
+    max_duration_s: int = 600  # Duration ~ Uniform{0..599}s, client.go:98
+    seed: int = 9  # the reference's fixed Poisson seed, client.go:109
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Engine-level configuration."""
+
+    # --- capacities (static tensor shapes) ---
+    max_nodes: int = 8  # physical node slots per cluster
+    max_virtual_nodes: int = 4  # reserved slots for borrowed virtual nodes
+    queue_capacity: int = 128  # per-queue job slots
+    max_running: int = 256  # concurrent running-job slots per cluster
+    max_arrivals: int = 1024  # arrival-stream length per cluster
+    max_msgs: int = 8  # cross-cluster messages per cluster per tick
+    max_ingest_per_tick: int = 64  # arrivals consumed per cluster per tick
+
+    # --- policy ---
+    policy: PolicyKind = PolicyKind.DELAY  # hardcoded DELAY in Run, scheduler.go:116
+    tick_ms: int = 1_000  # 1 s loop tick, scheduler.go:250,294,367
+    max_wait_ms: int = 10_000  # Level0->Level1 promotion, scheduler.go:115
+    borrowing: bool = False  # FIFO-path scheduler<->scheduler loans
+
+    # --- parity vs fast mode ---
+    # parity=True reproduces the Go loops' observable semantics exactly,
+    # including the remove-then-skip iteration quirk in the Level1 sweep
+    # (scheduler.go:305-327) and unbounded per-tick sweeps. parity=False caps
+    # per-tick placement work at `max_placements_per_tick` for throughput.
+    parity: bool = True
+    max_placements_per_tick: int = 16
+
+    # --- instrumentation ---
+    record_trace: bool = False  # record per-placement events
+    max_trace_events: int = 1 << 16
+    record_metrics: bool = False  # per-tick metric outputs from scan
+
+    trader: TraderConfig = dataclasses.field(default_factory=TraderConfig)
+    workload: WorkloadConfig = dataclasses.field(default_factory=WorkloadConfig)
+
+    @property
+    def total_nodes(self) -> int:
+        return self.max_nodes + self.max_virtual_nodes
+
+
+# Service-shell constants (reference values; see services/).
+REGISTRY_PORT = 3000  # pkg/registry/server.go:15
+HEARTBEAT_PERIOD_S = 3.0  # cmd/registry/main.go -> SetupRegistryService
+HEARTBEAT_ATTEMPTS = 3  # pkg/registry/server.go:140
+PROVIDE_JOBS_BATCH = 20  # pkg/scheduler/trader_server.go:75
+TRADE_COLLECT_WINDOW_S = 3.0  # pkg/trader/trader.go:249
+RETURN_ATTEMPTS = 3  # pkg/scheduler/server.go:275

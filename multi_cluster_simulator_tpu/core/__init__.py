@@ -1,0 +1,5 @@
+from multi_cluster_simulator_tpu.core.spec import ClusterSpec, NodeSpec, load_cluster_json
+from multi_cluster_simulator_tpu.core.state import SimState, init_state
+from multi_cluster_simulator_tpu.core.engine import Engine
+
+__all__ = ["ClusterSpec", "NodeSpec", "load_cluster_json", "SimState", "init_state", "Engine"]

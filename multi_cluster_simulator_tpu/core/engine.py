@@ -1,0 +1,464 @@
+"""The virtual-time simulation engine.
+
+The reference advances time by sleeping: scheduler loops tick every wall
+second (pkg/scheduler/scheduler.go:250,294,367) and every running job is a
+goroutine in ``time.Sleep(j.Duration)`` (cluster.go:141-161), so simulating
+X seconds of cluster time takes X seconds of wall time. Here one ``tick``
+is a pure function on ``SimState`` advanced under ``lax.scan`` — 1 ms of
+virtual time costs nanoseconds — with every per-cluster phase ``vmap``-ed
+over the cluster axis and every cross-cluster phase written as batched array
+ops (which become XLA collectives when the cluster axis is sharded).
+
+Tick phase order (the documented determinization of the reference's
+concurrent goroutines — see PARITY.md):
+
+  1. completions with ``end_t <= t`` release resources (RunJob wakeups);
+     finished foreign jobs are returned to their borrower (JobFinished ->
+     ReturnToBorrower -> /lent, scheduler.go:158-191, server.go:260-290)
+  2. expired virtual nodes deactivate (optional; the reference never
+     removes them — cluster.go:65-85)
+  3. arrivals with ``arr_t <= t`` enqueue (client POST /delay or /,
+     server.go:22-78)
+  4. the policy's scheduling pass:
+     DELAY — Level1 sweep then Level0 head + promotion (Delay(),
+       scheduler.go:298-369), including in parity mode the remove-then-skip
+       iteration quirk of the Level1 loop (scheduler.go:305-327)
+     FIFO — wait-head attempt / ready drain-to-first-failure / lent
+       best-effort (Fifo(), scheduler.go:216-296), emitting borrow
+       requests on wait-head failure (BorrowResources, server.go:160-248)
+     FFD — first-fit-decreasing bin-pack over Level0 (TPU-side upgrade,
+       BASELINE.json config 3)
+  5. cross-cluster borrow matching: feasibility over all lenders, lowest
+     cluster index wins (the deterministic version of Go's
+     first-200-OK-wins race, server.go:219-247)
+  6. trader market round on the monitor cadence (market/, trader.go:280-325)
+  7. trader state snapshot on the 5 s stream cadence (trader_server.go:24-47)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+from multi_cluster_simulator_tpu.core import state as st
+from multi_cluster_simulator_tpu.core.state import Arrivals, SimState, Trace
+from multi_cluster_simulator_tpu.ops import placement as P
+from multi_cluster_simulator_tpu.ops import queues as Q
+from multi_cluster_simulator_tpu.ops import runset as R
+
+# vmap prefix: map every per-cluster field over axis 0, broadcast the clock.
+_STATE_AXES = SimState(
+    t=None, node_cap=0, node_free=0, node_active=0, node_expire=0,
+    l0=0, l1=0, ready=0, wait=0, lent=0, borrowed=0, run=0, arr_ptr=0,
+    wait_total=0, wait_jobs=0, jobs_in_queue=0, placed_total=0, trader=0, trace=0,
+)
+_ARR_AXES = Arrivals(t=0, id=0, cores=0, mem=0, dur=0, n=0)
+
+
+def _trace_append(tr: Trace, do, t, job_id, node, src):
+    """Per-cluster capped event append (single-cluster view)."""
+    cap = tr.t.shape[-1]
+    ok = jnp.logical_and(do, tr.n < cap)
+    i = jnp.clip(tr.n, 0, cap - 1)
+
+    def w(a, v):
+        return a.at[i].set(jnp.where(ok, v, a[i]))
+
+    return Trace(t=w(tr.t, t), job=w(tr.job, job_id), node=w(tr.node, node),
+                 src=w(tr.src, jnp.int32(src)), n=tr.n + ok.astype(jnp.int32))
+
+
+def _attempt(s: SimState, job: Q.JobRec, t, do, src, record_trace: bool):
+    """One ScheduleJob(j) attempt (scheduler.go:127-139) on a single cluster:
+    first-fit over nodes; on success occupy resources and start the job.
+
+    A full running set makes the attempt fail (job stays queued) rather than
+    leak resources — a documented divergence (PARITY.md): size
+    ``max_running`` so it never binds."""
+    node = P.first_fit(s.node_free, s.node_active, job)
+    has_slot = jnp.logical_not(jnp.all(s.run.active))
+    success = jnp.logical_and(jnp.logical_and(do, has_slot), node >= 0)
+    free = P.occupy(s.node_free, node, job, success)
+    run = R.start(s.run, job, node, t, success)
+    trace = _trace_append(s.trace, success, t, job.id, node, src) if record_trace else s.trace
+    s = s.replace(node_free=free, run=run, trace=trace,
+                  placed_total=s.placed_total + success.astype(jnp.int32))
+    return s, success
+
+
+def _record_wait(total, rec_wait, enq_t, t, do):
+    """JobsMap bookkeeping on a scheduling attempt (scheduler.go:309-312):
+    TotalTime -= map[id]; map[id] = since(enqueue); TotalTime += map[id]."""
+    cur = (t - enq_t).astype(jnp.int32)
+    delta = jnp.where(do, (cur - rec_wait).astype(jnp.float32), 0.0)
+    return total + delta, jnp.where(do, cur, rec_wait)
+
+
+# --------------------------------------------------------------------------
+# phase 1/2: completions, lent returns, virtual-node expiry
+# --------------------------------------------------------------------------
+
+def _release_local(s: SimState, t):
+    run, free, done = R.release(s.run, s.node_free, t)
+    return s.replace(run=run, node_free=free), done
+
+
+def _expire_vnodes_local(s: SimState, t):
+    expired = jnp.logical_and(s.node_active, s.node_expire <= t)
+    zero = jnp.zeros_like(s.node_cap)
+    return s.replace(
+        node_active=jnp.logical_and(s.node_active, jnp.logical_not(expired)),
+        node_cap=jnp.where(expired[:, None], zero, s.node_cap),
+        node_free=jnp.where(expired[:, None], zero, s.node_free),
+        node_expire=jnp.where(expired, R.NEVER, s.node_expire),
+    )
+
+
+def _deliver_returns(state: SimState, run, done, cfg: SimConfig) -> SimState:
+    """Cross-cluster half of JobFinished: finished foreign jobs (owner >= 0)
+    are posted back to their borrower, which removes them from its
+    BorrowedQueue (server.go:115-137, 260-290). Global (non-vmapped) phase.
+
+    ``run`` is the running set *before* release cleared the completed slots.
+    """
+    C, S = done.shape
+    M = cfg.max_msgs
+    is_ret = jnp.logical_and(done, run.owner != Q.OWN)  # [C, S]
+    # first M returning slots per cluster
+    order = jnp.argsort(jnp.logical_not(is_ret), axis=1, stable=True)[:, :M]  # [C, M]
+    take = jnp.take_along_axis(is_ret, order, axis=1)  # [C, M]
+    f = lambda a: jnp.take_along_axis(a, order, axis=1)
+    msg_dst = jnp.where(take, f(run.owner), -1).reshape(-1)  # [C*M]
+    msg_id, msg_cores = f(run.id).reshape(-1), f(run.cores).reshape(-1)
+    msg_mem, msg_dur = f(run.mem).reshape(-1), f(run.dur).reshape(-1)
+
+    def remove_for_cluster(borrowed_q, c):
+        def body(q, m):
+            job = Q.JobRec(id=msg_id[m], cores=msg_cores[m], mem=msg_mem[m],
+                           dur=msg_dur[m], enq_t=jnp.int32(0), owner=c,
+                           rec_wait=jnp.int32(0))
+            hit = msg_dst[m] == c
+            matched = jnp.logical_and(
+                jnp.logical_and(borrowed_fields_eq(q, job), hit), q.slot_valid())
+            return Q.compact(q, jnp.logical_not(matched)), None
+
+        def borrowed_fields_eq(q, job):
+            m = q.id == job.id
+            m = jnp.logical_and(m, q.cores == job.cores)
+            m = jnp.logical_and(m, q.mem == job.mem)
+            return jnp.logical_and(m, q.dur == job.dur)
+
+        q, _ = jax.lax.scan(body, borrowed_q, jnp.arange(C * M, dtype=jnp.int32))
+        return q
+
+    borrowed = jax.vmap(remove_for_cluster)(state.borrowed, jnp.arange(C, dtype=jnp.int32))
+    return state.replace(borrowed=borrowed)
+
+
+# --------------------------------------------------------------------------
+# phase 3: arrivals
+# --------------------------------------------------------------------------
+
+def _ingest_local(s: SimState, arr: Arrivals, t, cfg: SimConfig, to_delay: bool):
+    """Enqueue arrivals with arr_t <= t. DELAY path appends to Level0 and
+    starts the wait timer + JobsCount + jobs_in_queue counter (the /delay
+    handler, server.go:53-78); FIFO path appends to ReadyQueue (the /
+    handler, server.go:23-51)."""
+    K = min(cfg.max_ingest_per_tick, arr.t.shape[-1])
+    idx = s.arr_ptr + jnp.arange(K, dtype=jnp.int32)
+    safe = jnp.clip(idx, 0, arr.t.shape[-1] - 1)
+    valid = jnp.logical_and(idx < arr.n, arr.t[safe] <= t)  # prefix mask (sorted)
+    rows = Q.JobQueue(
+        id=arr.id[safe], cores=arr.cores[safe], mem=arr.mem[safe],
+        dur=arr.dur[safe], enq_t=arr.t[safe],
+        owner=jnp.full((K,), Q.OWN, jnp.int32),
+        rec_wait=jnp.zeros((K,), jnp.int32),
+        count=jnp.sum(valid).astype(jnp.int32),
+    )
+    n = rows.count
+    if to_delay:
+        q = Q.push_many(s.l0, rows, valid)
+        s = s.replace(l0=q, wait_jobs=s.wait_jobs + n, jobs_in_queue=s.jobs_in_queue + n)
+    else:
+        q = Q.push_many(s.ready, rows, valid)
+        s = s.replace(ready=q)
+    return s.replace(arr_ptr=s.arr_ptr + n)
+
+
+# --------------------------------------------------------------------------
+# phase 4: scheduling passes
+# --------------------------------------------------------------------------
+
+def _delay_local(s: SimState, t, cfg: SimConfig):
+    """Delay() — the reference's live algorithm (scheduler.go:298-369)."""
+    QC = cfg.queue_capacity
+
+    # ---- Level1 sweep ----
+    def step(carry, i):
+        s, rec, placed, skip_next = carry
+        process = jnp.logical_and(i < s.l1.count, jnp.logical_not(skip_next))
+        job = Q.get(s.l1, i).replace(rec_wait=rec[i])
+        total, new_rec = _record_wait(s.wait_total, rec[i], job.enq_t, t, process)
+        rec = rec.at[i].set(new_rec)
+        s = s.replace(wait_total=total)
+        s, success = _attempt(s, job, t, process, st.SRC_L1, cfg.record_trace)
+        s = s.replace(jobs_in_queue=s.jobs_in_queue - success.astype(jnp.int32))
+        placed = placed.at[i].set(success)
+        # Parity: Go removes L1[i] in place and `i++` skips the element that
+        # slides into position i (scheduler.go:319) — equivalent on the
+        # original order to "after a success, skip the next element".
+        skip_next = success if cfg.parity else jnp.zeros((), bool)
+        return (s, rec, placed, skip_next), None
+
+    init = (s, s.l1.rec_wait, jnp.zeros((QC,), bool), jnp.zeros((), bool))
+    (s, rec, placed, _), _ = jax.lax.scan(step, init, jnp.arange(QC, dtype=jnp.int32))
+    l1 = Q.compact(s.l1.replace(rec_wait=rec), jnp.logical_not(placed))
+    s = s.replace(l1=l1)
+
+    # ---- Level0 head ----
+    process = s.l0.count > 0
+    job = Q.head(s.l0)
+    total, new_rec = _record_wait(s.wait_total, job.rec_wait, job.enq_t, t, process)
+    l0 = s.l0.replace(rec_wait=s.l0.rec_wait.at[0].set(new_rec))
+    s = s.replace(wait_total=total, l0=l0)
+    job = job.replace(rec_wait=new_rec)
+    s, success = _attempt(s, job, t, process, st.SRC_L0, cfg.record_trace)
+    s = s.replace(jobs_in_queue=s.jobs_in_queue - success.astype(jnp.int32))
+    promote = jnp.logical_and(
+        jnp.logical_and(process, jnp.logical_not(success)),
+        (t - job.enq_t) >= cfg.max_wait_ms,
+    )
+    s = s.replace(
+        l0=Q.pop_front(s.l0, jnp.logical_or(success, promote)),
+        l1=Q.push_back(s.l1, job, promote),
+    )
+    return s
+
+
+def _ffd_local(s: SimState, t, cfg: SimConfig):
+    """First-fit-decreasing bin-pack over Level0 — one XLA sort + the shared
+    placement sweep. Not in the reference; BASELINE.json config 3."""
+    QC = cfg.queue_capacity
+    order = P.best_fit_decreasing_order(s.l0.cores, s.l0.mem, s.l0.slot_valid())
+
+    def step(carry, k):
+        s, placed = carry
+        i = order[k]
+        process = i < s.l0.count
+        job = Q.get(s.l0, i)
+        total, new_rec = _record_wait(s.wait_total, job.rec_wait, job.enq_t, t, process)
+        s = s.replace(wait_total=total,
+                      l0=s.l0.replace(rec_wait=s.l0.rec_wait.at[i].set(new_rec)))
+        s, success = _attempt(s, job, t, process, st.SRC_L0, cfg.record_trace)
+        s = s.replace(jobs_in_queue=s.jobs_in_queue - success.astype(jnp.int32))
+        placed = placed.at[i].set(success)
+        return (s, placed), None
+
+    (s, placed), _ = jax.lax.scan(step, (s, jnp.zeros((QC,), bool)),
+                                  jnp.arange(QC, dtype=jnp.int32))
+    return s.replace(l0=Q.compact(s.l0, jnp.logical_not(placed)))
+
+
+def _fifo_local(s: SimState, t, cfg: SimConfig):
+    """Fifo() (scheduler.go:216-296) as ordered masked phases; see PARITY.md
+    for the derivation of the per-tick semantics from the Go loop's
+    sleep/continue structure. Returns (state, borrow_want, borrow_job)."""
+    QC = cfg.queue_capacity
+    wait_active = s.wait.count > 0
+
+    # ---- ready drain (only when the wait queue is empty): place from the
+    # head until the first failure; the failing job moves to WaitQueue ----
+    def dstep(carry, i):
+        s, stopped, taken, fail_job, any_fail = carry
+        process = jnp.logical_and(
+            jnp.logical_not(wait_active),
+            jnp.logical_and(i < s.ready.count, jnp.logical_not(stopped)))
+        job = Q.get(s.ready, i)
+        s, success = _attempt(s, job, t, process, st.SRC_READY, cfg.record_trace)
+        fail = jnp.logical_and(process, jnp.logical_not(success))
+        taken = taken.at[i].set(process)  # pops regardless of outcome
+        fail_job = jax.tree.map(lambda a, b: jnp.where(fail, b, a), fail_job, job)
+        return (s, jnp.logical_or(stopped, fail), taken, fail_job,
+                jnp.logical_or(any_fail, fail)), None
+
+    init = (s, jnp.zeros((), bool), jnp.zeros((QC,), bool), Q.JobRec.invalid(),
+            jnp.zeros((), bool))
+    (s, _, taken, fail_job, any_fail), _ = jax.lax.scan(
+        dstep, init, jnp.arange(QC, dtype=jnp.int32))
+    s = s.replace(ready=Q.compact(s.ready, jnp.logical_not(taken)),
+                  wait=Q.push_back(s.wait, fail_job, any_fail))
+
+    # ---- wait-head attempt (the branch at scheduler.go:219-252) ----
+    process_w = s.wait.count > 0
+    wjob = Q.head(s.wait)
+    s, wsuccess = _attempt(s, wjob, t, process_w, st.SRC_WAIT, cfg.record_trace)
+    s = s.replace(wait=Q.pop_front(s.wait, wsuccess))
+    borrow_want = jnp.logical_and(process_w, jnp.logical_not(wsuccess))
+    if not cfg.borrowing:
+        borrow_want = jnp.zeros((), bool)
+
+    # ---- lent best-effort (scheduler.go:277-291): reached only in a tick
+    # where wait was empty and ready drained clean ----
+    lent_ok = jnp.logical_and(
+        jnp.logical_and(jnp.logical_not(wait_active), jnp.logical_not(any_fail)),
+        jnp.logical_and(s.ready.count == 0, s.lent.count > 0))
+    ljob = Q.head(s.lent)
+    s, lsuccess = _attempt(s, ljob, t, lent_ok, st.SRC_LENT, cfg.record_trace)
+    s = s.replace(lent=Q.pop_front(s.lent, lsuccess))
+    return s, borrow_want, wjob
+
+
+def _borrow_match(state: SimState, want, jobs: Q.JobRec, cfg: SimConfig) -> SimState:
+    """Global borrow phase: BorrowResources' broadcast + first-win
+    (server.go:160-248) determinized to lowest-lender-cluster-index.
+
+    ``want``: [C] bool, ``jobs``: JobRec with [C] leaves (each cluster's
+    failing wait-head). Feasibility is Lend()'s strict > check
+    (scheduler.go:194-202) against the lender's current state — i.e. after
+    this tick's scheduling pass, per PARITY.md phase 4 — and no reservation
+    is made, matching the Go handler."""
+    C = want.shape[0]
+
+    # feas[l, b]: can lender l host borrower b's job?
+    def lender_view(free_l, active_l):
+        return jax.vmap(lambda c, m: P.can_lend(free_l, active_l,
+                                                Q.JobRec.invalid().replace(cores=c, mem=m))
+                        )(jobs.cores, jobs.mem)
+
+    feas = jax.vmap(lender_view)(state.node_free, state.node_active)  # [C(l), C(b)]
+    eye = jnp.eye(C, dtype=bool)
+    feas = jnp.logical_and(feas, jnp.logical_not(eye))  # never self-lend
+    feas = jnp.logical_and(feas, want[None, :])
+    lender_idx = jnp.argmax(feas, axis=0).astype(jnp.int32)  # first feasible lender
+    matched = jnp.any(feas, axis=0)  # [C(b)]
+    winner = jnp.where(matched, lender_idx, -1)
+
+    # Borrower side: j.Ownership = own URL (server.go:166), push to
+    # BorrowedQueue, pop WaitQueue (scheduler.go:239-242).
+    cidx = jnp.arange(C, dtype=jnp.int32)
+    owned = jobs.replace(owner=cidx)  # [C] leaves
+
+    def borrower_update(s_wait, s_borrowed, job, m):
+        return Q.pop_front(s_wait, m), Q.push_back(s_borrowed, job, m)
+
+    wait, borrowed = jax.vmap(borrower_update)(state.wait, state.borrowed, owned, matched)
+
+    # Lender side: append to LentQueue (server.go:94-107). Several borrowers
+    # may win the same lender in one tick (the Go handler takes them all);
+    # deliver in borrower-index order.
+    send_rows = Q.JobQueue(
+        id=owned.id, cores=owned.cores, mem=owned.mem, dur=owned.dur,
+        enq_t=owned.enq_t, owner=owned.owner, rec_wait=owned.rec_wait,
+        count=jnp.sum(matched).astype(jnp.int32))
+
+    def lender_update(lent_q, l):
+        take = jnp.logical_and(matched, winner == l)
+        return Q.push_many(lent_q, send_rows, take)
+
+    lent = jax.vmap(lender_update)(state.lent, cidx)
+    return state.replace(wait=wait, borrowed=borrowed, lent=lent)
+
+
+# --------------------------------------------------------------------------
+# phase 7: trader-visible state snapshot
+# --------------------------------------------------------------------------
+
+def _snapshot(state: SimState, t, cfg: SimConfig) -> SimState:
+    """Refresh each trader's cached cluster state on the stream cadence
+    (trader_server.go:24-47: 5 s ClusterState stream; trader.go:71-108)."""
+    do = (t % cfg.trader.state_cadence_ms) == 0
+    cu, mu = st.utilization(state)
+    aw = st.avg_wait_ms(state)
+    tr = state.trader
+    pick = lambda new, old: jnp.where(do, new, old)
+    return state.replace(trader=tr.replace(
+        snap_core_util=pick(cu, tr.snap_core_util),
+        snap_mem_util=pick(mu, tr.snap_mem_util),
+        snap_avg_wait=pick(aw, tr.snap_avg_wait)))
+
+
+# --------------------------------------------------------------------------
+# Engine
+# --------------------------------------------------------------------------
+
+class Engine:
+    """Builds the jitted tick/run functions for a given SimConfig."""
+
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        if cfg.trader.enabled:
+            try:
+                from multi_cluster_simulator_tpu.market import trader as market
+            except ModuleNotFoundError as e:  # pragma: no cover
+                raise NotImplementedError(
+                    "the trader market (market/) is not available in this build"
+                ) from e
+            self._trade_round = functools.partial(market.trade_round, cfg=cfg)
+        else:
+            self._trade_round = None
+
+    # -- single tick (pure; vmap/global composition) --
+    def tick(self, state: SimState, arrivals: Arrivals) -> SimState:
+        cfg = self.cfg
+        t = state.t + cfg.tick_ms
+
+        # 1. completions (+ returns of finished foreign jobs)
+        run_before = state.run
+        st2, done = jax.vmap(_release_local, in_axes=(_STATE_AXES, None),
+                             out_axes=(_STATE_AXES, 0))(state, t)
+        state = st2
+        if cfg.borrowing:
+            state = _deliver_returns(state, run_before, done, cfg)
+
+        # 2. virtual-node expiry (off in parity mode — reference keeps them)
+        if cfg.trader.enabled and cfg.trader.expire_virtual_nodes:
+            state = jax.vmap(_expire_vnodes_local, in_axes=(_STATE_AXES, None),
+                             out_axes=_STATE_AXES)(state, t)
+
+        # 3. arrivals
+        to_delay = cfg.policy in (PolicyKind.DELAY, PolicyKind.FFD)
+        state = jax.vmap(functools.partial(_ingest_local, cfg=cfg, to_delay=to_delay),
+                         in_axes=(_STATE_AXES, _ARR_AXES, None),
+                         out_axes=_STATE_AXES)(state, arrivals, t)
+
+        # 4. scheduling pass
+        if cfg.policy == PolicyKind.DELAY:
+            state = jax.vmap(functools.partial(_delay_local, cfg=cfg),
+                             in_axes=(_STATE_AXES, None), out_axes=_STATE_AXES)(state, t)
+        elif cfg.policy == PolicyKind.FFD:
+            state = jax.vmap(functools.partial(_ffd_local, cfg=cfg),
+                             in_axes=(_STATE_AXES, None), out_axes=_STATE_AXES)(state, t)
+        else:  # FIFO
+            state, want, bjobs = jax.vmap(
+                functools.partial(_fifo_local, cfg=cfg),
+                in_axes=(_STATE_AXES, None),
+                out_axes=(_STATE_AXES, 0, 0))(state, t)
+            # 5. borrow matching
+            if cfg.borrowing:
+                state = _borrow_match(state, want, bjobs, cfg)
+
+        # 6. trader market round
+        if self._trade_round is not None:
+            state = self._trade_round(state, t)
+
+        # 7. snapshot cadence
+        if cfg.trader.enabled:
+            state = _snapshot(state, t, cfg)
+
+        return state.replace(t=t)
+
+    # -- scan driver --
+    def run(self, state: SimState, arrivals: Arrivals, n_ticks: int) -> SimState:
+        def body(s, _):
+            return self.tick(s, arrivals), None
+
+        state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+        return state
+
+    def run_jit(self):
+        """A jitted (state, arrivals, n_ticks-static) -> state."""
+        return jax.jit(self.run, static_argnums=(2,))

@@ -1,0 +1,114 @@
+"""Cluster topology specs and loaders.
+
+The reference's whole topology config surface is a JSON file decoded straight
+into its Go ``Cluster`` struct (cmd/scheduler/main.go:52-59,
+assets/cluster_small.json). We accept the same JSON schema (capitalized Go
+field names) plus a snake_case variant, and convert to padded arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Sequence
+
+import numpy as np
+
+RES = 2  # resource axis: [cores, memory]
+CORES, MEM = 0, 1
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeSpec:
+    """One machine. Reference: Node, pkg/scheduler/cluster.go:127-138."""
+
+    id: int
+    cores: int
+    memory: int
+    type: str = "physical"
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSpec:
+    """One cluster of nodes. Reference: Cluster, pkg/scheduler/cluster.go:14-24."""
+
+    id: int
+    nodes: tuple[NodeSpec, ...]
+
+    @property
+    def total_cores(self) -> int:
+        return sum(n.cores for n in self.nodes)
+
+    @property
+    def total_memory(self) -> int:
+        return sum(n.memory for n in self.nodes)
+
+    def to_json(self) -> dict:
+        """Serialize in the reference's Go-struct JSON shape (for /newClient)."""
+        return {
+            "Id": self.id,
+            "Nodes": [
+                {
+                    "Id": n.id,
+                    "Type": n.type,
+                    "Memory": n.memory,
+                    "Cores": n.cores,
+                    "MemoryAvailable": n.memory,
+                    "CoresAvailable": n.cores,
+                }
+                for n in self.nodes
+            ],
+        }
+
+
+def _node_from_json(d: dict) -> NodeSpec:
+    def g(*names, default=None):
+        for n in names:
+            if n in d:
+                return d[n]
+        if default is not None:
+            return default
+        raise KeyError(f"missing any of {names} in node spec {d}")
+
+    return NodeSpec(
+        id=int(g("Id", "id")),
+        cores=int(g("Cores", "cores")),
+        memory=int(g("Memory", "memory")),
+        type=str(g("Type", "type", default="physical")),
+    )
+
+
+def cluster_from_json(d: dict) -> ClusterSpec:
+    nodes = tuple(_node_from_json(n) for n in d.get("Nodes", d.get("nodes", [])))
+    return ClusterSpec(id=int(d.get("Id", d.get("id", 0))), nodes=nodes)
+
+
+def load_cluster_json(path: str) -> ClusterSpec:
+    """Load a cluster spec from the reference's assets JSON schema."""
+    with open(path) as f:
+        return cluster_from_json(json.load(f))
+
+
+def uniform_cluster(cluster_id: int, n_nodes: int, cores: int = 32, memory: int = 24_000) -> ClusterSpec:
+    """Synthesize a cluster of identical nodes (the shape of both reference
+    assets: 5 or 10 nodes x 32 cores x 24000 MB)."""
+    return ClusterSpec(
+        id=cluster_id,
+        nodes=tuple(NodeSpec(id=i + 1, cores=cores, memory=memory) for i in range(n_nodes)),
+    )
+
+
+def capacities_array(specs: Sequence[ClusterSpec], max_nodes: int) -> np.ndarray:
+    """Stack cluster specs into a padded [C, max_nodes, RES] int32 capacity
+    tensor. Padded node slots have zero capacity (never feasible)."""
+    C = len(specs)
+    cap = np.zeros((C, max_nodes, RES), dtype=np.int32)
+    for c, spec in enumerate(specs):
+        if len(spec.nodes) > max_nodes:
+            raise ValueError(
+                f"cluster {spec.id} has {len(spec.nodes)} nodes > max_nodes={max_nodes}"
+            )
+        for i, n in enumerate(spec.nodes):
+            cap[c, i, CORES] = n.cores
+            cap[c, i, MEM] = n.memory
+    return cap

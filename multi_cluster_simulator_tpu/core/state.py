@@ -1,0 +1,164 @@
+"""World-state tensors.
+
+One ``SimState`` holds the entire constellation: every per-cluster field has a
+leading cluster axis ``C``. This is the tensor re-design of the reference's
+per-process singletons (``var sched = Scheduler{...}``,
+pkg/scheduler/server.go:20; ``var trader Trader``, pkg/trader/trader.go:327):
+where the Go system is N OS processes × six locked slices each, here it is
+one pytree the engine threads through ``lax.scan``, shardable over the
+cluster axis on a device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.core.spec import RES, ClusterSpec, capacities_array
+from multi_cluster_simulator_tpu.ops import queues as Q
+from multi_cluster_simulator_tpu.ops import runset as R
+
+# trace source-queue codes
+SRC_L1, SRC_L0, SRC_READY, SRC_WAIT, SRC_LENT, SRC_VNODE_HOLD = 0, 1, 2, 3, 4, 5
+
+
+@struct.dataclass
+class Arrivals:
+    """Pre-generated, time-sorted arrival stream (read-only during a run).
+
+    The tensor form of the workload client's HTTP POST stream
+    (pkg/client/client.go:85-147 -> pkg/scheduler/server.go:53-78).
+    """
+
+    t: jax.Array  # [C, A] int32 ms, nondecreasing per cluster
+    id: jax.Array  # [C, A] int32
+    cores: jax.Array  # [C, A] int32
+    mem: jax.Array  # [C, A] int32
+    dur: jax.Array  # [C, A] int32 ms
+    n: jax.Array  # [C] int32 valid prefix length
+
+
+@struct.dataclass
+class TraderState:
+    """Per-cluster trader agent state (pkg/trader/trader.go:24-39,71-108).
+
+    The snapshot fields mirror the trader's cached ``clusterState``, refreshed
+    on the reference's 5 s stream cadence rather than instantaneously."""
+
+    snap_core_util: jax.Array  # [C] f32
+    snap_mem_util: jax.Array  # [C] f32
+    snap_avg_wait: jax.Array  # [C] f32 ms
+    cooldown_until: jax.Array  # [C] i32 — RequestPolicyMonitor's post-trade sleeps
+    seller_locked_until: jax.Array  # [C] i32 — one-contract-at-a-time + 20s TTL
+    next_contract_id: jax.Array  # [C] i32 — serial ids (trader/server.go:26,46)
+    spent: jax.Array  # [C] f32 — cumulative price paid (budget accounting)
+
+
+@struct.dataclass
+class Trace:
+    """Per-cluster placement event ring (capped append)."""
+
+    t: jax.Array  # [C, E] i32
+    job: jax.Array  # [C, E] i32
+    node: jax.Array  # [C, E] i32
+    src: jax.Array  # [C, E] i32
+    n: jax.Array  # [C] i32
+
+
+@struct.dataclass
+class SimState:
+    t: jax.Array  # [] i32 — the virtual clock (shared; ticks are lockstep)
+    # nodes
+    node_cap: jax.Array  # [C, N, RES] i32 (virtual slots 0 until activated)
+    node_free: jax.Array  # [C, N, RES] i32
+    node_active: jax.Array  # [C, N] bool
+    node_expire: jax.Array  # [C, N] i32 — virtual-node expiry (NEVER default)
+    # queues (reference scheduler.go:19-30)
+    l0: Q.JobQueue  # [C, ...] DELAY Level0
+    l1: Q.JobQueue  # DELAY Level1
+    ready: Q.JobQueue  # FIFO ReadyQueue
+    wait: Q.JobQueue  # FIFO WaitQueue
+    lent: Q.JobQueue  # foreign jobs I host
+    borrowed: Q.JobQueue  # my jobs sent away
+    run: R.RunningSet  # [C, S]
+    # workload cursor
+    arr_ptr: jax.Array  # [C] i32 — next unconsumed arrival
+    # WaitTime stats (scheduler.go:48-63)
+    wait_total: jax.Array  # [C] f32 ms (TotalTime)
+    wait_jobs: jax.Array  # [C] i32 (JobsCount)
+    jobs_in_queue: jax.Array  # [C] i32 (the up/down counter, metrics.go:14)
+    placed_total: jax.Array  # [C] i32 — lifetime placements (throughput metric)
+    trader: TraderState
+    trace: Trace
+
+
+def avg_wait_ms(s: SimState) -> jax.Array:
+    """WaitTime.GetAverage() (scheduler.go:56-63)."""
+    return jnp.where(s.wait_jobs > 0, s.wait_total / jnp.maximum(s.wait_jobs, 1), 0.0)
+
+
+def utilization(s: SimState) -> tuple[jax.Array, jax.Array]:
+    """(core_util, mem_util) per cluster — GetResourceUtilization
+    (cluster.go:46-63): used/total over active nodes."""
+    used = jnp.sum(jnp.where(s.node_active[..., None], s.node_cap - s.node_free, 0), axis=-2)
+    total = jnp.sum(jnp.where(s.node_active[..., None], s.node_cap, 0), axis=-2)
+    util = used.astype(jnp.float32) / jnp.maximum(total, 1).astype(jnp.float32)
+    return util[..., 0], util[..., 1]
+
+
+def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec]) -> SimState:
+    """Build the initial batched state from cluster specs."""
+    C = len(specs)
+    N = cfg.total_nodes
+    cap_phys = capacities_array(specs, cfg.max_nodes)  # [C, max_nodes, RES]
+    cap = np.zeros((C, N, RES), dtype=np.int32)
+    cap[:, : cfg.max_nodes] = cap_phys
+    active = (cap.sum(-1) > 0)
+
+    def batched_queue():
+        return jax.tree.map(lambda a: jnp.broadcast_to(a, (C,) + a.shape), Q.empty(cfg.queue_capacity))
+
+    zf = jnp.zeros((C,), jnp.float32)
+    zi = jnp.zeros((C,), jnp.int32)
+    E = cfg.max_trace_events
+    never = jnp.full((C, N), R.NEVER, jnp.int32)
+    return SimState(
+        t=jnp.int32(0),
+        node_cap=jnp.asarray(cap),
+        node_free=jnp.asarray(cap.copy()),
+        node_active=jnp.asarray(active),
+        node_expire=never,
+        l0=batched_queue(),
+        l1=batched_queue(),
+        ready=batched_queue(),
+        wait=batched_queue(),
+        lent=batched_queue(),
+        borrowed=batched_queue(),
+        run=jax.tree.map(lambda a: jnp.broadcast_to(a, (C,) + a.shape), R.empty(cfg.max_running)),
+        arr_ptr=zi,
+        wait_total=zf,
+        wait_jobs=zi,
+        jobs_in_queue=zi,
+        placed_total=zi,
+        trader=TraderState(
+            snap_core_util=zf,
+            snap_mem_util=zf,
+            snap_avg_wait=zf,
+            cooldown_until=zi,
+            seller_locked_until=zi,
+            next_contract_id=jnp.ones((C,), jnp.int32),
+            spent=zf,
+        ),
+        trace=Trace(
+            t=jnp.zeros((C, E), jnp.int32),
+            job=jnp.full((C, E), -1, jnp.int32),
+            node=jnp.full((C, E), -1, jnp.int32),
+            src=jnp.full((C, E), -1, jnp.int32),
+            n=zi,
+        ),
+    )

@@ -1,0 +1,3 @@
+from multi_cluster_simulator_tpu.ops import placement, queues, runset
+
+__all__ = ["placement", "queues", "runset"]

@@ -1,0 +1,168 @@
+"""Padded, mask-disciplined job queues.
+
+The reference keeps six mutex-guarded Go slices per scheduler (ReadyQueue,
+WaitQueue, LentQueue, BorrowedQueue, Level0, Level1 —
+pkg/scheduler/scheduler.go:19-30). Here a queue is a struct-of-arrays pytree
+with a scalar ``count``: valid entries occupy slots ``[0, count)`` in FIFO
+order, so "head" is slot 0 and append writes at slot ``count``. All ops are
+pure, static-shape, and written for a single cluster — the engine ``vmap``s
+them over the cluster axis.
+
+Job fields mirror the reference's ``Job`` struct (scheduler.go:65-73):
+id, cores, mem, duration, enqueue-time (``WaitTime time.Time``), owner
+(``Ownership string`` — here the borrower's cluster index, -1 for "my own
+job"), plus ``rec_wait``, the last wait recorded in the scheduler's
+``WaitTime.JobsMap`` (scheduler.go:48-63).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+INVALID_ID = jnp.int32(-1)
+OWN = jnp.int32(-1)  # owner value for "my own job" (Ownership == "")
+
+
+@struct.dataclass
+class JobQueue:
+    id: jax.Array  # [Q] int32; INVALID_ID in empty slots
+    cores: jax.Array  # [Q] int32
+    mem: jax.Array  # [Q] int32
+    dur: jax.Array  # [Q] int32 (ms)
+    enq_t: jax.Array  # [Q] int32 (ms, virtual clock)
+    owner: jax.Array  # [Q] int32 (borrower cluster index; OWN = mine)
+    rec_wait: jax.Array  # [Q] int32 (ms, last JobsMap record)
+    count: jax.Array  # [] int32
+
+    @property
+    def capacity(self) -> int:
+        return self.id.shape[-1]
+
+    def slot_valid(self) -> jax.Array:
+        """[Q] bool — which slots hold live jobs."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.count
+
+
+@struct.dataclass
+class JobRec:
+    """A single job as a pytree of scalars (one row of a JobQueue)."""
+
+    id: jax.Array
+    cores: jax.Array
+    mem: jax.Array
+    dur: jax.Array
+    enq_t: jax.Array
+    owner: jax.Array
+    rec_wait: jax.Array
+
+    @staticmethod
+    def invalid() -> "JobRec":
+        z = jnp.int32(0)
+        return JobRec(id=INVALID_ID, cores=z, mem=z, dur=z, enq_t=z, owner=OWN, rec_wait=z)
+
+
+_FIELDS = ("id", "cores", "mem", "dur", "enq_t", "owner", "rec_wait")
+
+
+def empty(capacity: int) -> JobQueue:
+    z = jnp.zeros((capacity,), jnp.int32)
+    return JobQueue(
+        id=jnp.full((capacity,), INVALID_ID, jnp.int32),
+        cores=z,
+        mem=z,
+        dur=z,
+        enq_t=z,
+        owner=jnp.full((capacity,), OWN, jnp.int32),
+        rec_wait=z,
+        count=jnp.int32(0),
+    )
+
+
+def get(q: JobQueue, i: Any) -> JobRec:
+    return JobRec(**{f: getattr(q, f)[i] for f in _FIELDS})
+
+
+def head(q: JobQueue) -> JobRec:
+    return get(q, 0)
+
+
+def push_back(q: JobQueue, job: JobRec, do: jax.Array) -> JobQueue:
+    """Append one job if ``do`` (and capacity allows)."""
+    ok = jnp.logical_and(do, q.count < q.capacity)
+    idx = jnp.clip(q.count, 0, q.capacity - 1)
+    new = {
+        f: getattr(q, f).at[idx].set(
+            jnp.where(ok, getattr(job, f), getattr(q, f)[idx])
+        )
+        for f in _FIELDS
+    }
+    return q.replace(count=q.count + ok.astype(jnp.int32), **new)
+
+
+def push_many(q: JobQueue, jobs: JobQueue, take: jax.Array) -> JobQueue:
+    """Append all rows of ``jobs`` where ``take`` is set, preserving order.
+
+    ``take`` is a [Qj] bool mask over ``jobs`` slots. Overflowing entries are
+    dropped (sized configs should make this impossible).
+    """
+    order = jnp.argsort(jnp.logical_not(take), stable=True)  # taken rows first
+    n_take = jnp.sum(take).astype(jnp.int32)
+    dst = q.count + jnp.arange(jobs.capacity, dtype=jnp.int32)  # dst for k-th taken
+    ok = jnp.logical_and(jnp.arange(jobs.capacity) < n_take, dst < q.capacity)
+    dst = jnp.where(ok, dst, q.capacity)  # out-of-range writes are dropped
+    new = {}
+    for f in _FIELDS:
+        src = getattr(jobs, f)[order]
+        new[f] = getattr(q, f).at[dst].set(src, mode="drop")
+    added = jnp.minimum(n_take, q.capacity - q.count)
+    return q.replace(count=q.count + added, **new)
+
+
+def pop_front(q: JobQueue, do: jax.Array) -> JobQueue:
+    """Drop the head job if ``do`` (FIFO pop), shifting everything left."""
+    inv = empty(1)
+    new = {}
+    for f in _FIELDS:
+        a = getattr(q, f)
+        shifted = jnp.roll(a, -1).at[-1].set(getattr(inv, f)[0])
+        new[f] = jnp.where(do, shifted, a)
+    n = jnp.maximum(q.count - do.astype(jnp.int32), 0)
+    return q.replace(count=n, **new)
+
+
+def compact(q: JobQueue, keep: jax.Array) -> JobQueue:
+    """Stable-remove all valid slots where ``keep`` is False.
+
+    This is the tensor analogue of the Go in-place slice deletions
+    (scheduler.go:319,165,184). ``keep`` is evaluated on valid slots only.
+    """
+    keep = jnp.logical_and(keep, q.slot_valid())
+    drop = jnp.logical_not(keep)
+    order = jnp.argsort(drop, stable=True)  # kept rows first, stable
+    n_keep = jnp.sum(keep).astype(jnp.int32)
+    live = jnp.arange(q.capacity, dtype=jnp.int32) < n_keep
+    inv = JobRec.invalid()
+    new = {}
+    for f in _FIELDS:
+        a = getattr(q, f)[order]
+        new[f] = jnp.where(live, a, getattr(inv, f))
+    return q.replace(count=n_keep, **new)
+
+
+def remove_matching(q: JobQueue, job: JobRec, match_fields=("id", "cores", "mem", "dur")) -> JobQueue:
+    """Remove entries equal to ``job`` on the given fields.
+
+    Mirrors the reference's whole-struct-equality dequeues
+    (``if j == sched.BorrowedQueue[i]``, server.go:131-135, scheduler.go:164,
+    172, 184). Matching on (id, cores, mem, dur) is the documented
+    determinization — the Go structs also compare State/WaitTime/Ownership,
+    which survive the borrow round-trip unchanged.
+    """
+    m = jnp.ones((q.capacity,), bool)
+    for f in match_fields:
+        m = jnp.logical_and(m, getattr(q, f) == getattr(job, f))
+    return compact(q, jnp.logical_not(m))

@@ -1,0 +1,3 @@
+from multi_cluster_simulator_tpu.oracle.go_semantics import Oracle
+
+__all__ = ["Oracle"]

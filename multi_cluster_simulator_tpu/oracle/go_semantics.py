@@ -1,0 +1,246 @@
+"""Deterministic pure-Python oracle of the reference's scheduler semantics.
+
+This is the golden-trace generator for parity tests: a straight-line
+re-implementation of the Go loops (Fifo/Delay, pkg/scheduler/scheduler.go:
+216-369; borrow, server.go:160-248) under the determinization documented in
+PARITY.md — same phase order, same quirks (the Level1 remove-then-skip
+iteration, strict-vs-non-strict feasibility, whole-struct-equality dequeues),
+written with plain lists and dicts so it can be independently reviewed
+against the Go source. The TPU engine must produce bit-identical placement
+traces to this oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+from multi_cluster_simulator_tpu.core.spec import ClusterSpec
+from multi_cluster_simulator_tpu.core.state import (
+    SRC_L0, SRC_L1, SRC_LENT, SRC_READY, SRC_WAIT, Arrivals,
+)
+
+
+@dataclasses.dataclass
+class OJob:
+    id: int
+    cores: int
+    mem: int
+    dur: int
+    enq_t: int
+    owner: int = -1  # borrower cluster index; -1 = own (Ownership == "")
+    rec_wait: int = 0  # WaitTime.JobsMap entry
+
+    def key(self):
+        return (self.id, self.cores, self.mem, self.dur)
+
+
+@dataclasses.dataclass
+class ORunning:
+    end_t: int
+    node: int
+    job: OJob
+
+
+class OCluster:
+    def __init__(self, spec: ClusterSpec):
+        self.free = [[n.cores, n.memory] for n in spec.nodes]
+        self.l0: list[OJob] = []
+        self.l1: list[OJob] = []
+        self.ready: list[OJob] = []
+        self.wait: list[OJob] = []
+        self.lent: list[OJob] = []
+        self.borrowed: list[OJob] = []
+        self.running: list[ORunning] = []
+        self.wait_total = 0  # TotalTime, ms
+        self.wait_jobs = 0  # JobsCount
+        self.jobs_in_queue = 0
+        self.arr_ptr = 0
+
+    def first_fit(self, j: OJob) -> Optional[int]:
+        """ScheduleJob's >= scan (scheduler.go:127-139)."""
+        for i, (fc, fm) in enumerate(self.free):
+            if fc >= j.cores and fm >= j.mem:
+                return i
+        return None
+
+    def can_lend(self, j: OJob) -> bool:
+        """Lend's strict > scan (scheduler.go:194-202)."""
+        return any(fc > j.cores and fm > j.mem for fc, fm in self.free)
+
+
+class Oracle:
+    def __init__(self, cfg: SimConfig, specs: list[ClusterSpec], arrivals: Arrivals):
+        self.cfg = cfg
+        self.clusters = [OCluster(s) for s in specs]
+        self.arr = arrivals
+        self.t = 0
+        # events: (t, cluster, job_id, node, src)
+        self.trace: list[tuple[int, int, int, int, int]] = []
+
+    # -- helpers --
+    def _place(self, c: int, j: OJob, node: int, src: int):
+        cl = self.clusters[c]
+        cl.free[node][0] -= j.cores
+        cl.free[node][1] -= j.mem
+        cl.running.append(ORunning(end_t=self.t + j.dur, node=node, job=j))
+        self.trace.append((self.t, c, j.id, node, src))
+
+    # -- phases --
+    def _releases(self):
+        returns: list[tuple[int, OJob]] = []  # (borrower cluster, job)
+        for c, cl in enumerate(self.clusters):
+            done = [r for r in cl.running if r.end_t <= self.t]
+            cl.running = [r for r in cl.running if r.end_t > self.t]
+            for r in done:
+                cl.free[r.node][0] += r.job.cores
+                cl.free[r.node][1] += r.job.mem
+                if r.job.owner >= 0:  # lent job -> ReturnToBorrower
+                    returns.append((r.job.owner, r.job))
+        for dst, j in returns:
+            bq = self.clusters[dst].borrowed
+            self.clusters[dst].borrowed = [b for b in bq if b.key() != j.key()]
+
+    def _arrivals(self):
+        to_delay = self.cfg.policy in (PolicyKind.DELAY, PolicyKind.FFD)
+        a = self.arr
+        for c, cl in enumerate(self.clusters):
+            n = int(a.n[c])
+            while cl.arr_ptr < n and int(a.t[c, cl.arr_ptr]) <= self.t:
+                i = cl.arr_ptr
+                j = OJob(id=int(a.id[c, i]), cores=int(a.cores[c, i]),
+                         mem=int(a.mem[c, i]), dur=int(a.dur[c, i]),
+                         enq_t=int(a.t[c, i]))
+                if to_delay:
+                    cl.l0.append(j)
+                    cl.wait_jobs += 1
+                    cl.jobs_in_queue += 1
+                else:
+                    cl.ready.append(j)
+                cl.arr_ptr += 1
+
+    def _record_wait(self, cl: OCluster, j: OJob):
+        cur = self.t - j.enq_t
+        cl.wait_total += cur - j.rec_wait
+        j.rec_wait = cur
+
+    def _delay_pass(self, c: int):
+        cl = self.clusters[c]
+        # Level1 sweep — the literal Go loop with in-place removal + i++
+        i = 0
+        while i < len(cl.l1):
+            j = cl.l1[i]
+            self._record_wait(cl, j)
+            node = cl.first_fit(j)
+            if node is not None:
+                self._place(c, j, node, SRC_L1)
+                del cl.l1[i]
+                cl.jobs_in_queue -= 1
+            i += 1  # skips the element that slid into position i on removal
+        # Level0 head
+        if cl.l0:
+            j = cl.l0[0]
+            self._record_wait(cl, j)
+            node = cl.first_fit(j)
+            if node is not None:
+                self._place(c, j, node, SRC_L0)
+                cl.l0.pop(0)
+                cl.jobs_in_queue -= 1
+            elif self.t - j.enq_t >= self.cfg.max_wait_ms:
+                cl.l1.append(cl.l0.pop(0))
+
+    def _ffd_pass(self, c: int):
+        cl = self.clusters[c]
+        order = sorted(range(len(cl.l0)),
+                       key=lambda i: (-cl.l0[i].cores, -cl.l0[i].mem, i))
+        placed = set()
+        for i in order:
+            j = cl.l0[i]
+            self._record_wait(cl, j)
+            node = cl.first_fit(j)
+            if node is not None:
+                self._place(c, j, node, SRC_L0)
+                placed.add(i)
+                cl.jobs_in_queue -= 1
+        cl.l0 = [j for i, j in enumerate(cl.l0) if i not in placed]
+
+    def _fifo_pass(self, c: int) -> Optional[OJob]:
+        """Returns the borrow-request job, if any (see PARITY.md §FIFO)."""
+        cl = self.clusters[c]
+        wait_active = len(cl.wait) > 0
+        any_fail = False
+        if not wait_active:
+            while cl.ready and not any_fail:
+                j = cl.ready.pop(0)
+                node = cl.first_fit(j)
+                if node is not None:
+                    self._place(c, j, node, SRC_READY)
+                else:
+                    cl.wait.append(j)
+                    any_fail = True
+        borrow_req = None
+        if cl.wait:
+            j = cl.wait[0]
+            node = cl.first_fit(j)
+            if node is not None:
+                self._place(c, j, node, SRC_WAIT)
+                cl.wait.pop(0)
+            elif self.cfg.borrowing:
+                borrow_req = j
+        if (not wait_active) and (not any_fail) and not cl.ready and cl.lent:
+            j = cl.lent[0]
+            node = cl.first_fit(j)
+            if node is not None:
+                self._place(c, j, node, SRC_LENT)
+                cl.lent.pop(0)
+        return borrow_req
+
+    def _borrow_match(self, requests: dict[int, OJob]):
+        """Feasibility over all lenders, lowest cluster index wins; no
+        reservation between matches (the Go /borrow handler only checks)."""
+        for b in sorted(requests):
+            j = requests[b]
+            winner = None
+            for l, cl in enumerate(self.clusters):
+                if l != b and cl.can_lend(j):
+                    winner = l
+                    break
+            if winner is None:
+                continue
+            sent = dataclasses.replace(j, owner=b)
+            bcl = self.clusters[b]
+            bcl.borrowed.append(dataclasses.replace(j, owner=b))
+            assert bcl.wait and bcl.wait[0].key() == j.key()
+            bcl.wait.pop(0)
+            self.clusters[winner].lent.append(sent)
+
+    # -- driver --
+    def tick(self):
+        self.t += self.cfg.tick_ms
+        self._releases()
+        self._arrivals()
+        requests: dict[int, OJob] = {}
+        for c in range(len(self.clusters)):
+            if self.cfg.policy == PolicyKind.DELAY:
+                self._delay_pass(c)
+            elif self.cfg.policy == PolicyKind.FFD:
+                self._ffd_pass(c)
+            else:
+                req = self._fifo_pass(c)
+                if req is not None:
+                    requests[c] = req
+        if self.cfg.borrowing and requests:
+            self._borrow_match(requests)
+
+    def run(self, n_ticks: int):
+        for _ in range(n_ticks):
+            self.tick()
+        return self
+
+    # -- stats accessors (for cross-checks beyond the trace) --
+    def avg_wait(self, c: int) -> float:
+        cl = self.clusters[c]
+        return cl.wait_total / cl.wait_jobs if cl.wait_jobs else 0.0

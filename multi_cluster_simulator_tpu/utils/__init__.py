@@ -1,0 +1,3 @@
+from multi_cluster_simulator_tpu.utils.trace import extract_trace
+
+__all__ = ["extract_trace"]

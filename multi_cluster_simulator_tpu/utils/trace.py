@@ -1,0 +1,56 @@
+"""Host-side helpers for reading engine traces and checking invariants."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from multi_cluster_simulator_tpu.core.state import SimState
+
+
+def extract_trace(state: SimState) -> list[list[tuple[int, int, int, int]]]:
+    """Per-cluster placement event lists of (t, job_id, node, src)."""
+    tr = state.trace
+    t = np.asarray(tr.t)
+    job = np.asarray(tr.job)
+    node = np.asarray(tr.node)
+    src = np.asarray(tr.src)
+    n = np.asarray(tr.n)
+    out = []
+    for c in range(t.shape[0]):
+        k = int(n[c])
+        out.append([(int(t[c, i]), int(job[c, i]), int(node[c, i]), int(src[c, i]))
+                    for i in range(k)])
+    return out
+
+
+def oracle_trace_per_cluster(oracle, n_clusters: int) -> list[list[tuple[int, int, int, int]]]:
+    """Reshape the oracle's global (t, cluster, job, node, src) list to the
+    engine's per-cluster layout."""
+    out = [[] for _ in range(n_clusters)]
+    for (t, c, j, node, src) in oracle.trace:
+        out[c].append((t, j, node, src))
+    return out
+
+
+def check_conservation(state: SimState) -> None:
+    """Invariant: free + sum(running on node) == capacity for active nodes,
+    and 0 <= free <= cap."""
+    free = np.asarray(state.node_free)
+    cap = np.asarray(state.node_cap)
+    active = np.asarray(state.node_active)
+    run = state.run
+    r_node = np.asarray(run.node)
+    r_cores = np.asarray(run.cores)
+    r_mem = np.asarray(run.mem)
+    r_act = np.asarray(run.active)
+    C, N, _ = free.shape
+    used = np.zeros((C, N, 2), np.int64)
+    for c in range(C):
+        for s in range(r_node.shape[1]):
+            if r_act[c, s]:
+                used[c, r_node[c, s], 0] += r_cores[c, s]
+                used[c, r_node[c, s], 1] += r_mem[c, s]
+    assert (free >= 0).all(), "negative free resources"
+    recon = free + used
+    mism = (recon != cap) & active[..., None]
+    assert not mism.any(), f"conservation violated at {np.argwhere(mism)[:5]}"

@@ -1,0 +1,3 @@
+from multi_cluster_simulator_tpu.workload.generator import generate_arrivals
+
+__all__ = ["generate_arrivals"]

@@ -1,0 +1,39 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX loads.
+
+Real TPU hardware in this environment is a single chip; multi-chip sharding
+is validated on virtual CPU devices exactly as the driver's
+``dryrun_multichip`` does.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from multi_cluster_simulator_tpu.config import SimConfig, WorkloadConfig  # noqa: E402
+from multi_cluster_simulator_tpu.core.spec import uniform_cluster  # noqa: E402
+from multi_cluster_simulator_tpu.workload.generator import generate_arrivals  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def small_spec():
+    """The reference's cluster_small.json shape: 5 nodes x (32 cores, 24000 MB)
+    (assets/cluster_small.json)."""
+    return uniform_cluster(1, 5)
+
+
+@pytest.fixture(scope="session")
+def big_spec():
+    """cluster_big.json shape: 10 nodes x (32 cores, 24000 MB)."""
+    return uniform_cluster(2, 10)
+
+
+def make_arrivals(cfg: SimConfig, n_clusters: int, horizon_ms: int, seed: int = 9,
+                  max_cores: int = 32, max_mem: int = 24_000):
+    return generate_arrivals(cfg.workload, n_clusters, cfg.max_arrivals,
+                             horizon_ms, max_cores, max_mem, seed=seed)

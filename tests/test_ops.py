@@ -1,0 +1,125 @@
+"""Unit tests for the queue / placement / running-set kernels."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from multi_cluster_simulator_tpu.ops import placement as P
+from multi_cluster_simulator_tpu.ops import queues as Q
+from multi_cluster_simulator_tpu.ops import runset as R
+
+
+def job(i=1, cores=2, mem=100, dur=5000, enq=0, owner=-1):
+    return Q.JobRec(id=jnp.int32(i), cores=jnp.int32(cores), mem=jnp.int32(mem),
+                    dur=jnp.int32(dur), enq_t=jnp.int32(enq),
+                    owner=jnp.int32(owner), rec_wait=jnp.int32(0))
+
+
+class TestQueues:
+    def test_push_pop_fifo_order(self):
+        q = Q.empty(8)
+        for i in range(3):
+            q = Q.push_back(q, job(i), jnp.bool_(True))
+        assert int(q.count) == 3
+        assert int(Q.head(q).id) == 0
+        q = Q.pop_front(q, jnp.bool_(True))
+        assert int(q.count) == 2
+        assert int(Q.head(q).id) == 1
+        assert int(q.id[2]) == int(Q.INVALID_ID)
+
+    def test_push_respects_mask_and_capacity(self):
+        q = Q.empty(2)
+        q = Q.push_back(q, job(1), jnp.bool_(False))
+        assert int(q.count) == 0
+        q = Q.push_back(q, job(1), jnp.bool_(True))
+        q = Q.push_back(q, job(2), jnp.bool_(True))
+        q = Q.push_back(q, job(3), jnp.bool_(True))  # over capacity -> dropped
+        assert int(q.count) == 2
+        assert int(q.id[1]) == 2
+
+    def test_push_many_stable(self):
+        q = Q.empty(8)
+        rows = Q.empty(4)
+        for i in range(4):
+            rows = Q.push_back(rows, job(10 + i), jnp.bool_(True))
+        take = jnp.array([True, False, True, True])
+        q = Q.push_many(q, rows, take)
+        assert int(q.count) == 3
+        assert [int(x) for x in q.id[:3]] == [10, 12, 13]
+
+    def test_compact_stable(self):
+        q = Q.empty(6)
+        for i in range(5):
+            q = Q.push_back(q, job(i), jnp.bool_(True))
+        keep = jnp.array([True, False, True, False, True, True])
+        q = Q.compact(q, keep)
+        assert int(q.count) == 3
+        assert [int(x) for x in q.id[:3]] == [0, 2, 4]
+        assert int(q.id[3]) == int(Q.INVALID_ID)
+
+    def test_remove_matching(self):
+        q = Q.empty(4)
+        q = Q.push_back(q, job(7, cores=1), jnp.bool_(True))
+        q = Q.push_back(q, job(8, cores=2), jnp.bool_(True))
+        q = Q.remove_matching(q, job(8, cores=2))
+        assert int(q.count) == 1
+        assert int(Q.head(q).id) == 7
+
+
+class TestPlacement:
+    def test_first_fit_order_and_feasibility(self):
+        free = jnp.array([[1, 50], [4, 500], [8, 500]], jnp.int32)
+        active = jnp.array([True, True, True])
+        assert int(P.first_fit(free, active, job(cores=4, mem=500))) == 1
+        assert int(P.first_fit(free, active, job(cores=9, mem=1))) == int(P.NO_NODE)
+
+    def test_inactive_nodes_skipped(self):
+        free = jnp.array([[8, 500], [8, 500]], jnp.int32)
+        active = jnp.array([False, True])
+        assert int(P.first_fit(free, active, job(cores=2, mem=10))) == 1
+
+    def test_strict_vs_nonstrict(self):
+        free = jnp.array([[4, 500]], jnp.int32)
+        active = jnp.array([True])
+        j = job(cores=4, mem=500)
+        assert int(P.first_fit(free, active, j)) == 0  # >= succeeds
+        assert not bool(P.can_lend(free, active, j))  # > fails
+
+    def test_occupy(self):
+        free = jnp.array([[4, 500], [8, 100]], jnp.int32)
+        f2 = P.occupy(free, jnp.int32(1), job(cores=2, mem=50), jnp.bool_(True))
+        assert f2.tolist() == [[4, 500], [6, 50]]
+        f3 = P.occupy(free, jnp.int32(1), job(cores=2, mem=50), jnp.bool_(False))
+        assert f3.tolist() == free.tolist()
+
+    def test_ffd_order(self):
+        cores = jnp.array([1, 5, 3, 9], jnp.int32)
+        mem = jnp.array([10, 10, 99, 10], jnp.int32)
+        valid = jnp.array([True, True, True, False])
+        order = P.best_fit_decreasing_order(cores, mem, valid)
+        assert [int(x) for x in order[:3]] == [1, 2, 0]
+
+
+class TestRunset:
+    def test_start_release_roundtrip(self):
+        rs = R.empty(4)
+        free = jnp.array([[8, 500]], jnp.int32)
+        j = job(1, cores=3, mem=100, dur=5000)
+        free = P.occupy(free, jnp.int32(0), j, jnp.bool_(True))
+        rs = R.start(rs, j, jnp.int32(0), jnp.int32(1000), jnp.bool_(True))
+        assert bool(rs.active[0]) and int(rs.end_t[0]) == 6000
+        rs, free, done = R.release(rs, free, jnp.int32(5000))
+        assert not bool(done.any())
+        rs, free, done = R.release(rs, free, jnp.int32(6000))
+        assert bool(done[0])
+        assert free.tolist() == [[8, 500]]
+        assert not bool(rs.active.any())
+
+    def test_release_multiple_same_node(self):
+        rs = R.empty(4)
+        free = jnp.array([[2, 300]], jnp.int32)
+        for i, (c, m) in enumerate([(3, 100), (3, 100)]):
+            rs = R.start(rs, job(i, cores=c, mem=m, dur=1000), jnp.int32(0),
+                         jnp.int32(0), jnp.bool_(True))
+        rs, free, done = R.release(rs, free, jnp.int32(1000))
+        assert int(done.sum()) == 2
+        assert free.tolist() == [[8, 500]]
