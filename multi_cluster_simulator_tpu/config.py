@@ -64,6 +64,10 @@ class TraderConfig:
     contract_ttl_ms: int = 20_000  # seller contract validity, trader/server.go:49
     matching: MatchKind = MatchKind.GREEDY
     sinkhorn_iters: int = 16
+    # "asbuilt" reproduces the reference's observable arithmetic (quirks
+    # included); "sane" is the documented intended behavior (MARKET.md).
+    small_node_sizing: str = "asbuilt"  # scheduler_client.go:201-289
+    carve_mode: str = "asbuilt"  # AllocateVirtualNodeResources, cluster.go:87-125
     # When True, borrowed virtual nodes expire after their contract duration
     # ("sane" mode). The reference keeps them forever (AddVirtualNode never
     # removes, pkg/scheduler/cluster.go:65-85), which the False default

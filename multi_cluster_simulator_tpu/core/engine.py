@@ -31,8 +31,9 @@ concurrent goroutines — see PARITY.md):
   5. cross-cluster borrow matching: feasibility over all lenders, lowest
      cluster index wins (the deterministic version of Go's
      first-200-OK-wins race, server.go:219-247)
-  6. trader market round on the monitor cadence (market/, trader.go:280-325)
-  7. trader state snapshot on the 5 s stream cadence (trader_server.go:24-47)
+  6. trader state snapshot on the 5 s stream cadence (trader_server.go:24-47)
+     — refreshed before any trade in the same tick (MARKET.md §clock)
+  7. trader market round on the monitor cadence (market/, trader.go:280-325)
 """
 
 from __future__ import annotations
@@ -118,44 +119,47 @@ def _expire_vnodes_local(s: SimState, t):
     )
 
 
-def _deliver_returns(state: SimState, run, done, cfg: SimConfig) -> SimState:
+def _deliver_returns(state: SimState, run, done, cfg: SimConfig, ex) -> SimState:
     """Cross-cluster half of JobFinished: finished foreign jobs (owner >= 0)
     are posted back to their borrower, which removes them from its
-    BorrowedQueue (server.go:115-137, 260-290). Global (non-vmapped) phase.
+    BorrowedQueue (server.go:115-137, 260-290). Global (non-vmapped) phase;
+    under sharding the message block rides one all-gather.
 
     ``run`` is the running set *before* release cleared the completed slots.
     """
-    C, S = done.shape
+    C_loc, S = done.shape
     M = cfg.max_msgs
-    is_ret = jnp.logical_and(done, run.owner != Q.OWN)  # [C, S]
+    # owner >= 0 is a borrower cluster; FOREIGN (-2) trader placeholders are
+    # returned to nobody (Go posts to the literal URL "Foreign" and gives up)
+    is_ret = jnp.logical_and(done, run.owner >= 0)  # [C_loc, S]
     # first M returning slots per cluster
-    order = jnp.argsort(jnp.logical_not(is_ret), axis=1, stable=True)[:, :M]  # [C, M]
-    take = jnp.take_along_axis(is_ret, order, axis=1)  # [C, M]
-    f = lambda a: jnp.take_along_axis(a, order, axis=1)
-    msg_dst = jnp.where(take, f(run.owner), -1).reshape(-1)  # [C*M]
-    msg_id, msg_cores = f(run.id).reshape(-1), f(run.cores).reshape(-1)
-    msg_mem, msg_dur = f(run.mem).reshape(-1), f(run.dur).reshape(-1)
+    order = jnp.argsort(jnp.logical_not(is_ret), axis=1, stable=True)[:, :M]
+    take = jnp.take_along_axis(is_ret, order, axis=1)  # [C_loc, M]
+    f = lambda a: ex.gather(jnp.take_along_axis(a, order, axis=1)).reshape(-1)
+    # dst = global borrower index; -1 marks an empty message slot
+    msg_dst = ex.gather(
+        jnp.where(take, jnp.take_along_axis(run.owner, order, axis=1), -1)
+    ).reshape(-1)  # [C_tot*M]
+    msg_id, msg_cores = f(run.id), f(run.cores)
+    msg_mem, msg_dur = f(run.mem), f(run.dur)
+    n_msgs = msg_dst.shape[0]
+    gidx = ex.global_index(C_loc)
 
     def remove_for_cluster(borrowed_q, c):
+        def eq(q, m):
+            hit = jnp.logical_and(
+                jnp.logical_and(q.id == msg_id[m], q.cores == msg_cores[m]),
+                jnp.logical_and(q.mem == msg_mem[m], q.dur == msg_dur[m]))
+            return jnp.logical_and(hit, msg_dst[m] == c)
+
         def body(q, m):
-            job = Q.JobRec(id=msg_id[m], cores=msg_cores[m], mem=msg_mem[m],
-                           dur=msg_dur[m], enq_t=jnp.int32(0), owner=c,
-                           rec_wait=jnp.int32(0))
-            hit = msg_dst[m] == c
-            matched = jnp.logical_and(
-                jnp.logical_and(borrowed_fields_eq(q, job), hit), q.slot_valid())
+            matched = jnp.logical_and(eq(q, m), q.slot_valid())
             return Q.compact(q, jnp.logical_not(matched)), None
 
-        def borrowed_fields_eq(q, job):
-            m = q.id == job.id
-            m = jnp.logical_and(m, q.cores == job.cores)
-            m = jnp.logical_and(m, q.mem == job.mem)
-            return jnp.logical_and(m, q.dur == job.dur)
-
-        q, _ = jax.lax.scan(body, borrowed_q, jnp.arange(C * M, dtype=jnp.int32))
+        q, _ = jax.lax.scan(body, borrowed_q, jnp.arange(n_msgs, dtype=jnp.int32))
         return q
 
-    borrowed = jax.vmap(remove_for_cluster)(state.borrowed, jnp.arange(C, dtype=jnp.int32))
+    borrowed = jax.vmap(remove_for_cluster)(state.borrowed, gidx)
     return state.replace(borrowed=borrowed)
 
 
@@ -312,66 +316,74 @@ def _fifo_local(s: SimState, t, cfg: SimConfig):
     return s, borrow_want, wjob
 
 
-def _borrow_match(state: SimState, want, jobs: Q.JobRec, cfg: SimConfig) -> SimState:
+def _borrow_match(state: SimState, want, jobs: Q.JobRec, cfg: SimConfig, ex) -> SimState:
     """Global borrow phase: BorrowResources' broadcast + first-win
     (server.go:160-248) determinized to lowest-lender-cluster-index.
 
-    ``want``: [C] bool, ``jobs``: JobRec with [C] leaves (each cluster's
-    failing wait-head). Feasibility is Lend()'s strict > check
+    ``want``: [C_loc] bool, ``jobs``: JobRec with [C_loc] leaves (each
+    cluster's failing wait-head). Feasibility is Lend()'s strict > check
     (scheduler.go:194-202) against the lender's current state — i.e. after
     this tick's scheduling pass, per PARITY.md phase 4 — and no reservation
-    is made, matching the Go handler."""
-    C = want.shape[0]
+    is made, matching the Go handler. Under sharding: one all-gather of the
+    probe jobs, one min-reduction for the winner — the collective form of
+    the goroutine fan-out/first-win idiom."""
+    C_loc = want.shape[0]
+    gidx = ex.global_index(C_loc)  # my lenders, global indices
+    g_want = ex.gather(want)  # [C_tot]
+    g_jobs: Q.JobRec = jax.tree.map(ex.gather, jobs)
+    C_tot = g_want.shape[0]
+    bidx = jnp.arange(C_tot, dtype=jnp.int32)
 
-    # feas[l, b]: can lender l host borrower b's job?
+    # feas[l_local, b_global]: can my lender l host borrower b's job?
     def lender_view(free_l, active_l):
-        return jax.vmap(lambda c, m: P.can_lend(free_l, active_l,
-                                                Q.JobRec.invalid().replace(cores=c, mem=m))
-                        )(jobs.cores, jobs.mem)
+        return jax.vmap(lambda c, m: P.can_lend(
+            free_l, active_l, Q.JobRec.invalid().replace(cores=c, mem=m))
+        )(g_jobs.cores, g_jobs.mem)
 
-    feas = jax.vmap(lender_view)(state.node_free, state.node_active)  # [C(l), C(b)]
-    eye = jnp.eye(C, dtype=bool)
-    feas = jnp.logical_and(feas, jnp.logical_not(eye))  # never self-lend
-    feas = jnp.logical_and(feas, want[None, :])
-    lender_idx = jnp.argmax(feas, axis=0).astype(jnp.int32)  # first feasible lender
-    matched = jnp.any(feas, axis=0)  # [C(b)]
-    winner = jnp.where(matched, lender_idx, -1)
+    feas = jax.vmap(lender_view)(state.node_free, state.node_active)
+    feas = jnp.logical_and(feas, gidx[:, None] != bidx[None, :])  # no self-lend
+    feas = jnp.logical_and(feas, g_want[None, :])
+    INF = jnp.int32(2**31 - 1)
+    local_best = jnp.min(jnp.where(feas, gidx[:, None], INF), axis=0)  # [C_tot]
+    winner = ex.allmin(local_best)  # lowest feasible lender, global
+    matched_g = winner < INF  # [C_tot]
 
-    # Borrower side: j.Ownership = own URL (server.go:166), push to
+    # Borrower side (local): j.Ownership = own URL (server.go:166), push to
     # BorrowedQueue, pop WaitQueue (scheduler.go:239-242).
-    cidx = jnp.arange(C, dtype=jnp.int32)
-    owned = jobs.replace(owner=cidx)  # [C] leaves
+    matched_loc = jnp.logical_and(matched_g[gidx], want)
+    owned = jobs.replace(owner=gidx)
 
     def borrower_update(s_wait, s_borrowed, job, m):
         return Q.pop_front(s_wait, m), Q.push_back(s_borrowed, job, m)
 
-    wait, borrowed = jax.vmap(borrower_update)(state.wait, state.borrowed, owned, matched)
+    wait, borrowed = jax.vmap(borrower_update)(state.wait, state.borrowed,
+                                               owned, matched_loc)
 
-    # Lender side: append to LentQueue (server.go:94-107). Several borrowers
-    # may win the same lender in one tick (the Go handler takes them all);
-    # deliver in borrower-index order.
+    # Lender side (local): append to LentQueue (server.go:94-107). Several
+    # borrowers may win the same lender in one tick (the Go handler takes
+    # them all); deliver in global borrower-index order.
     send_rows = Q.JobQueue(
-        id=owned.id, cores=owned.cores, mem=owned.mem, dur=owned.dur,
-        enq_t=owned.enq_t, owner=owned.owner, rec_wait=owned.rec_wait,
-        count=jnp.sum(matched).astype(jnp.int32))
+        id=g_jobs.id, cores=g_jobs.cores, mem=g_jobs.mem, dur=g_jobs.dur,
+        enq_t=g_jobs.enq_t, owner=bidx, rec_wait=g_jobs.rec_wait,
+        count=jnp.sum(matched_g).astype(jnp.int32))
 
-    def lender_update(lent_q, l):
-        take = jnp.logical_and(matched, winner == l)
+    def lender_update(lent_q, gl):
+        take = jnp.logical_and(matched_g, winner == gl)
         return Q.push_many(lent_q, send_rows, take)
 
-    lent = jax.vmap(lender_update)(state.lent, cidx)
+    lent = jax.vmap(lender_update)(state.lent, gidx)
     return state.replace(wait=wait, borrowed=borrowed, lent=lent)
 
 
 # --------------------------------------------------------------------------
-# phase 7: trader-visible state snapshot
+# phase 6: trader-visible state snapshot
 # --------------------------------------------------------------------------
 
 def _snapshot(state: SimState, t, cfg: SimConfig) -> SimState:
     """Refresh each trader's cached cluster state on the stream cadence
     (trader_server.go:24-47: 5 s ClusterState stream; trader.go:71-108)."""
     do = (t % cfg.trader.state_cadence_ms) == 0
-    cu, mu = st.utilization(state)
+    cu, mu = st.snapshot_utilization(state)
     aw = st.avg_wait_ms(state)
     tr = state.trader
     pick = lambda new, old: jnp.where(do, new, old)
@@ -386,10 +398,17 @@ def _snapshot(state: SimState, t, cfg: SimConfig) -> SimState:
 # --------------------------------------------------------------------------
 
 class Engine:
-    """Builds the jitted tick/run functions for a given SimConfig."""
+    """Builds the jitted tick/run functions for a given SimConfig.
 
-    def __init__(self, cfg: SimConfig):
+    ``ex`` is the cross-cluster exchange (parallel/exchange.py):
+    LocalExchange for a whole cluster axis on one device, MeshExchange when
+    the tick runs inside shard_map over a mesh (parallel/sharded_engine.py).
+    """
+
+    def __init__(self, cfg: SimConfig, ex=None):
+        from multi_cluster_simulator_tpu.parallel.exchange import LocalExchange
         self.cfg = cfg
+        self.ex = ex if ex is not None else LocalExchange()
         if cfg.trader.enabled:
             try:
                 from multi_cluster_simulator_tpu.market import trader as market
@@ -397,7 +416,8 @@ class Engine:
                 raise NotImplementedError(
                     "the trader market (market/) is not available in this build"
                 ) from e
-            self._trade_round = functools.partial(market.trade_round, cfg=cfg)
+            self._trade_round = functools.partial(market.trade_round, cfg=cfg,
+                                                  ex=self.ex)
         else:
             self._trade_round = None
 
@@ -412,7 +432,7 @@ class Engine:
                              out_axes=(_STATE_AXES, 0))(state, t)
         state = st2
         if cfg.borrowing:
-            state = _deliver_returns(state, run_before, done, cfg)
+            state = _deliver_returns(state, run_before, done, cfg, self.ex)
 
         # 2. virtual-node expiry (off in parity mode — reference keeps them)
         if cfg.trader.enabled and cfg.trader.expire_virtual_nodes:
@@ -439,15 +459,16 @@ class Engine:
                 out_axes=(_STATE_AXES, 0, 0))(state, t)
             # 5. borrow matching
             if cfg.borrowing:
-                state = _borrow_match(state, want, bjobs, cfg)
+                state = _borrow_match(state, want, bjobs, cfg, self.ex)
 
-        # 6. trader market round
-        if self._trade_round is not None:
-            state = self._trade_round(state, t)
-
-        # 7. snapshot cadence
+        # 6. trader state snapshot (before any trade in the same tick — the
+        # stream lands just ahead of the monitor wakeup, MARKET.md §clock)
         if cfg.trader.enabled:
             state = _snapshot(state, t, cfg)
+
+        # 7. trader market round
+        if self._trade_round is not None:
+            state = self._trade_round(state, t)
 
         return state.replace(t=t)
 

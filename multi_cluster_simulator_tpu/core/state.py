@@ -19,7 +19,7 @@ import numpy as np
 from flax import struct
 
 from multi_cluster_simulator_tpu.config import SimConfig
-from multi_cluster_simulator_tpu.core.spec import RES, ClusterSpec, capacities_array
+from multi_cluster_simulator_tpu.core.spec import CORES, MEM, RES, ClusterSpec, capacities_array
 from multi_cluster_simulator_tpu.ops import queues as Q
 from multi_cluster_simulator_tpu.ops import runset as R
 
@@ -53,6 +53,12 @@ class TraderState:
     snap_core_util: jax.Array  # [C] f32
     snap_mem_util: jax.Array  # [C] f32
     snap_avg_wait: jax.Array  # [C] f32 ms
+    # Totals are sent once on stream start and never refreshed (the
+    # ClusterChange flag is only true at construction, trader_server.go:17-34;
+    # SetTotalResources runs only at init, cluster.go:26-40) — so they stay
+    # the *physical* totals even after virtual nodes join.
+    snap_total_cores: jax.Array  # [C] i32
+    snap_total_mem: jax.Array  # [C] i32
     cooldown_until: jax.Array  # [C] i32 — RequestPolicyMonitor's post-trade sleeps
     seller_locked_until: jax.Array  # [C] i32 — one-contract-at-a-time + 20s TTL
     next_contract_id: jax.Array  # [C] i32 — serial ids (trader/server.go:26,46)
@@ -111,6 +117,20 @@ def utilization(s: SimState) -> tuple[jax.Array, jax.Array]:
     return util[..., 0], util[..., 1]
 
 
+def snapshot_utilization(s: SimState) -> tuple[jax.Array, jax.Array]:
+    """Utilization as the streamed ClusterState computes it
+    (GetResourceUtilization, cluster.go:46-63): usage summed over *all*
+    nodes (virtual included) divided by the cached *physical* totals
+    (SetTotalResources runs only at init) — so it can exceed 1.0 once
+    virtual nodes carry load."""
+    used = jnp.sum(s.node_cap - s.node_free, axis=-2)  # inactive slots are 0-0
+    cu = used[..., CORES].astype(jnp.float32) / jnp.maximum(
+        s.trader.snap_total_cores, 1).astype(jnp.float32)
+    mu = used[..., MEM].astype(jnp.float32) / jnp.maximum(
+        s.trader.snap_total_mem, 1).astype(jnp.float32)
+    return cu, mu
+
+
 def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec]) -> SimState:
     """Build the initial batched state from cluster specs."""
     C = len(specs)
@@ -149,6 +169,8 @@ def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec]) -> SimState:
             snap_core_util=zf,
             snap_mem_util=zf,
             snap_avg_wait=zf,
+            snap_total_cores=jnp.asarray(cap[:, :, CORES].sum(1), jnp.int32),
+            snap_total_mem=jnp.asarray(cap[:, :, MEM].sum(1), jnp.int32),
             cooldown_until=zi,
             seller_locked_until=zi,
             next_contract_id=jnp.ones((C,), jnp.int32),

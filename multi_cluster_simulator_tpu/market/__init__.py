@@ -1,0 +1,3 @@
+from multi_cluster_simulator_tpu.market.trader import trade_round, FOREIGN
+
+__all__ = ["trade_round", "FOREIGN"]

@@ -1,0 +1,205 @@
+"""The trader resource market as one batched round — pkg/trader re-designed.
+
+The reference runs one trader process per cluster: a 10 s monitor evaluates
+request policies against streamed cluster state, sizes a contract from the
+scheduler's Level1 backlog, fans RequestResource out to every peer trader,
+collects approvals in a price min-heap, and walks the heap calling
+ApproveContract until a seller successfully carves a virtual node
+(trader.go:280-325, 193-278; trader/server.go:31-85). Here the entire round —
+every cluster simultaneously as buyer and seller — is a handful of [C]- and
+[C, C]-shaped array ops inside the jitted tick: offer collection becomes a
+masked argmin over the seller axis, which lowers to collectives when the
+cluster axis is sharded. MARKET.md documents the deterministic semantics and
+every divergence from the Go races.
+
+Phase structure of one round (MARKET.md):
+  buyers:  policy check (snapshot state) -> contract sizing (Level1) ->
+  sellers: one-request-per-round lock -> ApproveTrade predicate ->
+           carve feasibility ->
+  match:   per buyer, lowest approving seller index whose carve succeeds
+           (all offers echo the buyer's price, trader/server.go:44, so the
+           reference's price heap degenerates to arrival order — we
+           determinize to seller index) ->
+  apply:   seller occupies carved amounts as Foreign placeholder jobs;
+           buyer activates a virtual node slot; cooldowns + locks update.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.core.spec import CORES, MEM
+from multi_cluster_simulator_tpu.core.state import SimState
+from multi_cluster_simulator_tpu.ops import carve as carve_ops
+from multi_cluster_simulator_tpu.ops import sizing
+from multi_cluster_simulator_tpu.ops import runset as R
+
+FOREIGN = jnp.int32(-2)  # owner sentinel: Ownership == "Foreign" (cluster.go:116)
+PLACEHOLDER_ID = jnp.int32(-3)
+
+
+def _tree_where(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _tree_take(tree, idx):
+    return jax.tree.map(lambda x: x[idx], tree)
+
+
+def trade_round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
+    mcfg = cfg.trader
+    do = (t % mcfg.monitor_period_ms) == 0
+    return jax.lax.cond(do, lambda s: _round(s, t, cfg, ex), lambda s: s, state)
+
+
+def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
+    """One market round over the (possibly sharded) cluster axis. Local
+    arrays are [C_loc]; gathered arrays are [C_tot]. Single-device,
+    C_loc == C_tot and the exchange ops are identities."""
+    mcfg = cfg.trader
+    tr = state.trader
+    C_loc = state.arr_ptr.shape[0]
+    INF = jnp.int32(2**31 - 1)
+    gidx = ex.global_index(C_loc)
+
+    # ---- buyers: request policies (trader.go:117-139; evaluation order
+    # WaitTime -> Utilization as appended in newTrader, trader.go:55-62) ----
+    eligible = tr.cooldown_until <= t
+    wt_broken = tr.snap_avg_wait > mcfg.request_max_wait_ms
+    ut_broken = jnp.logical_or(tr.snap_core_util > mcfg.request_core_max,
+                               tr.snap_mem_util > mcfg.request_mem_max)
+    want_fast = jnp.logical_and(eligible, wt_broken)
+    want_small = jnp.logical_and(eligible,
+                                 jnp.logical_and(jnp.logical_not(wt_broken), ut_broken))
+    buyer = jnp.logical_or(want_fast, want_small)
+
+    # ---- contract sizing from each buyer's Level1 backlog
+    # (ProvideJobs streams a GetLevel1 copy, trader_server.go:69-94) ----
+    budget = jnp.float32(mcfg.budget)
+    cc, mc = jnp.float32(mcfg.max_core_cost), jnp.float32(mcfg.max_mem_cost)
+    fast = jax.vmap(lambda q: sizing.fast_node_contract(q, budget, cc, mc))(state.l1)
+    if mcfg.small_node_sizing == "asbuilt":
+        small = jax.vmap(lambda q: sizing.small_node_contract_asbuilt(q, budget, cc, mc))(state.l1)
+    else:
+        small = jax.vmap(lambda q: sizing.small_node_contract_sane(q, budget, cc, mc))(state.l1)
+    con = _tree_where(want_fast, fast, small)  # Contract with [C_loc] leaves
+
+    # A zero-resource contract trades fine in Go (and is approved by every
+    # idle seller); it happens when Level1 is empty. Keep it — parity.
+
+    # ---- broadcast requests (the RequestResource fan-out, trader.go:211-229)
+    g_buyer = ex.gather(buyer)  # [C_tot]
+    g_con = jax.tree.map(ex.gather, con)
+    C_tot = g_buyer.shape[0]
+    bidx = jnp.arange(C_tot, dtype=jnp.int32)
+
+    # ---- sellers (local): one-request-per-round lock + ApproveTrade ----
+    locked = tr.seller_locked_until > t
+    req = jnp.logical_and(g_buyer[None, :], gidx[:, None] != bidx[None, :])  # [s_loc, b]
+    has_req = jnp.any(req, axis=1)
+    b_first = jnp.argmax(req, axis=1).astype(jnp.int32)  # lowest global buyer
+    process = jnp.logical_and(has_req, jnp.logical_not(locked))
+
+    csel = _tree_take(g_con, b_first)  # the contract each local seller evaluates
+    # ApproveTrade (trader.go:141-167), all in float32 against the snapshot:
+    tot_c = tr.snap_total_cores.astype(jnp.float32)
+    tot_m = tr.snap_total_mem.astype(jnp.float32)
+    avail_c = tot_c - tot_c * tr.snap_core_util
+    avail_m = tot_m - tot_m * tr.snap_mem_util
+    t_sec = csel.time_ms.astype(jnp.float32) / 1000.0
+    incentive = (jnp.float32(mcfg.min_core_incentive) * csel.cores.astype(jnp.float32) * t_sec
+                 + jnp.float32(mcfg.min_mem_incentive) * csel.mem.astype(jnp.float32) * t_sec)
+    approve_ok = jnp.logical_and(
+        jnp.logical_and(tr.snap_core_util < mcfg.approve_core_threshold,
+                        tr.snap_mem_util < mcfg.approve_mem_threshold),
+        jnp.logical_and(jnp.logical_and(avail_c >= csel.cores.astype(jnp.float32),
+                                        avail_m >= csel.mem.astype(jnp.float32)),
+                        csel.price >= incentive))
+    approve = jnp.logical_and(process, approve_ok)
+
+    # ---- carve feasibility (ApproveContract -> ProvideVirtualNode) ----
+    amounts, carve_ok = jax.vmap(
+        lambda free, act, ccon: carve_ops.carve_plan(free, act, ccon.cores,
+                                                     ccon.mem, mode=mcfg.carve_mode)
+    )(state.node_free, state.node_active, csel)  # [C_loc, N, RES], [C_loc]
+
+    # ---- match: per buyer, lowest approving seller whose carve succeeds;
+    # the min-reduction is the collective form of the offer heap ----
+    cand_ok = jnp.logical_and(approve, carve_ok)  # [s_loc]
+    wmat = jnp.full((C_loc, C_tot), INF, jnp.int32).at[
+        jnp.arange(C_loc), b_first].set(jnp.where(cand_ok, gidx, INF))
+    winner = ex.allmin(jnp.min(wmat, axis=0))  # [C_tot] global seller idx
+    has_winner = winner < INF
+    # sellers the buyer called ApproveContract on: every candidate up to and
+    # including the winner (heap fall-through, trader.go:265-276); all
+    # candidates if none carved. Their currentContract resets immediately
+    # (trader/server.go:83); non-attempted approvers stay locked until TTL.
+    attempted_any = jnp.logical_and(
+        approve, jnp.where(has_winner[b_first], gidx <= winner[b_first], True))
+
+    new_lock = jnp.where(process, t + mcfg.contract_ttl_ms, tr.seller_locked_until)
+    new_lock = jnp.where(attempted_any, 0, new_lock)
+
+    win_sell = jnp.logical_and(cand_ok, winner[b_first] == gidx)
+
+    # ---- apply: seller side — occupy carved amounts as Foreign placeholder
+    # jobs for the contract duration (cluster.go:116) ----
+    def seller_apply(free, run, amts, ccon, win):
+        free = free - jnp.where(win, amts, 0)
+
+        def add_placeholder(rn, n):
+            occ = jnp.logical_and(win, jnp.logical_or(amts[n, CORES] > 0,
+                                                      amts[n, MEM] > 0))
+            slot = jnp.argmin(rn.active).astype(jnp.int32)
+            ok = jnp.logical_and(occ, jnp.logical_not(rn.active[slot]))
+            w = lambda a, v: a.at[slot].set(jnp.where(ok, v, a[slot]))
+            return R.RunningSet(
+                end_t=w(rn.end_t, t + ccon.time_ms), node=w(rn.node, n),
+                cores=w(rn.cores, amts[n, CORES]), mem=w(rn.mem, amts[n, MEM]),
+                id=w(rn.id, PLACEHOLDER_ID), owner=w(rn.owner, FOREIGN),
+                dur=w(rn.dur, ccon.time_ms), enq_t=w(rn.enq_t, t),
+                active=w(rn.active, ok)), None
+
+        N = free.shape[0]
+        run, _ = jax.lax.scan(add_placeholder, run, jnp.arange(N, dtype=jnp.int32))
+        return free, run
+
+    free, run = jax.vmap(seller_apply)(state.node_free, state.run, amounts, csel, win_sell)
+
+    # ---- apply: buyer side — AddVirtualNode (cluster.go:65-85): the
+    # NodeObject echoes the contract's cores/mem (trader_server.go:58) ----
+    wcon = con  # own contract per local buyer
+    got_node = jnp.logical_and(buyer, has_winner[gidx])
+
+    def buyer_apply(cap, free_b, active, expire, ccon, got):
+        vstart = cfg.max_nodes
+        is_v = jnp.arange(cap.shape[0]) >= vstart
+        slot_free = jnp.logical_and(is_v, jnp.logical_not(active))
+        slot = jnp.argmax(slot_free).astype(jnp.int32)
+        ok = jnp.logical_and(got, jnp.any(slot_free))
+        newcap = jnp.stack([ccon.cores, ccon.mem]).astype(jnp.int32)
+        cap = cap.at[slot].set(jnp.where(ok, newcap, cap[slot]))
+        free_b = free_b.at[slot].set(jnp.where(ok, newcap, free_b[slot]))
+        active = active.at[slot].set(jnp.where(ok, True, active[slot]))
+        exp_val = (t + ccon.time_ms) if mcfg.expire_virtual_nodes else R.NEVER
+        expire = expire.at[slot].set(jnp.where(ok, exp_val, expire[slot]))
+        return cap, free_b, active, expire
+
+    cap, free, active, expire = jax.vmap(buyer_apply)(
+        state.node_cap, free, state.node_active, state.node_expire, wcon, got_node)
+
+    # ---- cooldowns (the 4 min / 2 min sleeps, trader.go:296-302) ----
+    cooldown = jnp.where(
+        got_node, t + mcfg.cooldown_success_ms,
+        jnp.where(buyer, t + mcfg.cooldown_failure_ms, tr.cooldown_until))
+    spent = tr.spent + jnp.where(got_node, wcon.price, 0.0)
+
+    return state.replace(
+        node_cap=cap, node_free=free, node_active=active, node_expire=expire,
+        run=run,
+        trader=tr.replace(seller_locked_until=new_lock, cooldown_until=cooldown,
+                          spent=spent,
+                          next_contract_id=tr.next_contract_id
+                          + buyer.astype(jnp.int32)))

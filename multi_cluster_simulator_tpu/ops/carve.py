@@ -1,0 +1,77 @@
+"""Lender-side virtual-node carving — AllocateVirtualNodeResources
+(pkg/scheduler/cluster.go:87-125) as a node-axis scan.
+
+The Go walk computes, per node, ``diff = |req - avail|`` per resource,
+decrements the request by ``diff`` (zeroing it when ``diff > req``) and
+occupies ``diff`` on the node as a placeholder "Foreign" job for the contract
+duration. Two consequences of that arithmetic are handled explicitly here:
+
+- **as-built request bookkeeping is preserved** — whether the carve succeeds
+  (request fully consumed) matches the Go outcome exactly;
+- **occupied amounts are clamped to [0, avail]** — the Go code can occupy
+  more than a node has free, which underflows its *unsigned* counters and
+  turns the node into effectively infinite capacity. Reproducing that wrap
+  would poison the whole simulation, so parity mode clamps the occupancy
+  while keeping the request arithmetic (MARKET.md §carving documents this
+  as the one deliberate deviation).
+
+``mode="sane"`` instead takes ``min(req, avail)`` per node — the obvious
+intended behavior.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from multi_cluster_simulator_tpu.core.spec import CORES, MEM
+
+
+def carve_plan(free: jax.Array, active: jax.Array, req_cores, req_mem,
+               mode: str = "asbuilt"):
+    """Plan a carve across the node axis.
+
+    free: [N, RES] current free resources; active: [N] — the Go walk visits
+    every *real* node in order, including virtual ones (``c.Nodes`` has no
+    padding), so inactive padded slots must be skipped: an avail=0 slot
+    would otherwise zero the remaining request under the as-built abs-diff
+    arithmetic and fake a successful carve. Returns (amounts [N, RES] i32,
+    ok bool) where ok means the request was fully consumed
+    (cluster.go:119-122's error check).
+    """
+    N = free.shape[0]
+
+    def step(carry, n):
+        rc0, rm0 = carry
+        rc, rm = rc0, rm0
+        avail_c = jnp.maximum(free[n, CORES], 0)
+        avail_m = jnp.maximum(free[n, MEM], 0)
+        if mode == "asbuilt":
+            # diff = |req - avail| when req > 0 (cluster.go:96-102)
+            dc = jnp.where(rc > 0, jnp.abs(rc - avail_c), 0)
+            dm = jnp.where(rm > 0, jnp.abs(rm - avail_m), 0)
+            # request decrement (cluster.go:104-114)
+            rc = jnp.where(dc > rc, 0, rc - dc)
+            rm = jnp.where(dm > rm, 0, rm - dm)
+            # occupancy, clamped to what the node actually has
+            oc = jnp.clip(dc, 0, avail_c)
+            om = jnp.clip(dm, 0, avail_m)
+        elif mode == "sane":
+            oc = jnp.minimum(rc, avail_c)
+            om = jnp.minimum(rm, avail_m)
+            rc = rc - oc
+            rm = rm - om
+        else:
+            raise ValueError(f"unknown carve mode {mode!r}")
+        skip = jnp.logical_not(active[n])
+        rc = jnp.where(skip, rc0, rc)
+        rm = jnp.where(skip, rm0, rm)
+        oc = jnp.where(skip, 0, oc)
+        om = jnp.where(skip, 0, om)
+        return (rc, rm), jnp.stack([oc, om])
+
+    (rc, rm), amounts = jax.lax.scan(
+        step, (req_cores.astype(jnp.int32), req_mem.astype(jnp.int32)),
+        jnp.arange(N, dtype=jnp.int32))
+    ok = jnp.logical_and(rc <= 0, rm <= 0)
+    return amounts.astype(jnp.int32), ok

@@ -1,0 +1,114 @@
+"""Contract-sizing kernels — the trader's greedy node-size calculators.
+
+The reference sizes a resource request by streaming Level1 jobs from its
+scheduler and folding them greedily (pkg/trader/scheduler_client.go:126-289).
+Both algorithms are re-expressed as masked scans over the Level1 queue
+tensor; "as-built" mode reproduces the Go code's observable arithmetic —
+including its quirks — and "sane" mode is the documented intended behavior
+(see MARKET.md).
+
+Times here are int32 ms; prices float32 (Go mixes float32/float64 — a
+documented divergence).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from multi_cluster_simulator_tpu.ops.queues import JobQueue
+
+
+@struct.dataclass
+class Contract:
+    """ContractRequest (proto/trader.proto:21-28), minus the transport bits."""
+
+    cores: jax.Array  # [] i32
+    mem: jax.Array  # [] i32
+    time_ms: jax.Array  # [] i32
+    price: jax.Array  # [] f32
+
+    @staticmethod
+    def zero() -> "Contract":
+        return Contract(cores=jnp.int32(0), mem=jnp.int32(0),
+                        time_ms=jnp.int32(0), price=jnp.float32(0.0))
+
+
+def _price(cores, mem, time_ms, core_cost, mem_cost):
+    """price = t_sec*cores*coreCost + t_sec*mem*memCost
+    (scheduler_client.go:150, 271)."""
+    t_s = time_ms.astype(jnp.float32) / 1000.0
+    return t_s * cores.astype(jnp.float32) * core_cost + t_s * mem.astype(jnp.float32) * mem_cost
+
+
+def fast_node_contract(l1: JobQueue, budget, core_cost, mem_cost) -> Contract:
+    """calculateFastNodeSize (scheduler_client.go:126-170): size a node to
+    run every Level1 job concurrently from t=0 — cores/mem are running sums,
+    time the running max of durations — stopping before the job whose
+    inclusion would reach the budget (strict <; negative budget = unlimited).
+
+    The running price is monotone, so the accepted set is a prefix: one
+    cumsum + cummax and a masked argmax, no sequential scan."""
+    valid = l1.slot_valid()
+    cores = jnp.cumsum(jnp.where(valid, l1.cores, 0))
+    mem = jnp.cumsum(jnp.where(valid, l1.mem, 0))
+    time_ms = jax.lax.cummax(jnp.where(valid, l1.dur, 0))
+    price = _price(cores, mem, time_ms, core_cost, mem_cost)
+    ok = jnp.logical_and(valid, jnp.logical_or(budget < 0, price < budget))
+    k = jnp.sum(ok.astype(jnp.int32)) - 1  # last accepted prefix index
+    has = k >= 0
+    g = lambda a, z: jnp.where(has, a[jnp.maximum(k, 0)], z)
+    return Contract(cores=g(cores, jnp.int32(0)), mem=g(mem, jnp.int32(0)),
+                    time_ms=g(time_ms, jnp.int32(0)), price=g(price, jnp.float32(0.0)))
+
+
+def small_node_contract_asbuilt(l1: JobQueue, budget, core_cost, mem_cost) -> Contract:
+    """calculateSmallNodeSize *as built* (scheduler_client.go:201-289).
+
+    The Go timeline bookkeeping is inert (``atTime`` is never appended to, so
+    every job sees a single t=0 slot with zero load); the observable fold is:
+    cores/mem accumulate sums (a zero-sized need leaves them unchanged), and
+    the contract time becomes ``dur_k`` when ``dur_k > T_{k-1}`` **and is
+    reset to 0 otherwise** (``jobState.time`` keeps its zero value when the
+    new job doesn't extend the contract, scheduler_client.go:263-265).
+    Budget stop as in fast-node. Preserved quirks and all — this is what the
+    reference actually requests."""
+    valid = l1.slot_valid()
+
+    def step(carry, i):
+        c, stopped = carry
+        v = jnp.logical_and(valid[i], jnp.logical_not(stopped))
+        nc = c.cores + jnp.where(l1.cores[i] > 0, l1.cores[i], 0)
+        nm = c.mem + jnp.where(l1.mem[i] > 0, l1.mem[i], 0)
+        nt = jnp.where(l1.dur[i] > c.time_ms, l1.dur[i], jnp.int32(0))
+        np_ = _price(nc, nm, nt, core_cost, mem_cost)
+        accept = jnp.logical_and(v, jnp.logical_or(budget < 0, np_ < budget))
+        c = Contract(cores=jnp.where(accept, nc, c.cores),
+                     mem=jnp.where(accept, nm, c.mem),
+                     time_ms=jnp.where(accept, nt, c.time_ms),
+                     price=jnp.where(accept, np_, c.price))
+        stopped = jnp.logical_or(stopped, jnp.logical_and(v, jnp.logical_not(accept)))
+        return (c, stopped), None
+
+    (c, _), _ = jax.lax.scan(step, (Contract.zero(), jnp.zeros((), bool)),
+                             jnp.arange(l1.capacity, dtype=jnp.int32))
+    return c
+
+
+def small_node_contract_sane(l1: JobQueue, budget, core_cost, mem_cost) -> Contract:
+    """The *intended* small node: the cheapest node that can run the Level1
+    backlog sequentially — max individual cores/mem, summed durations —
+    truncated at the budget. (The reference's cost-minimizing timeline never
+    executes; this is the documented sane replacement, MARKET.md §sizing.)"""
+    valid = l1.slot_valid()
+    cores = jax.lax.cummax(jnp.where(valid, l1.cores, 0))
+    mem = jax.lax.cummax(jnp.where(valid, l1.mem, 0))
+    time_ms = jnp.cumsum(jnp.where(valid, l1.dur, 0))
+    price = _price(cores, mem, time_ms, core_cost, mem_cost)
+    ok = jnp.logical_and(valid, jnp.logical_or(budget < 0, price < budget))
+    k = jnp.sum(ok.astype(jnp.int32)) - 1
+    has = k >= 0
+    g = lambda a, z: jnp.where(has, a[jnp.maximum(k, 0)], z)
+    return Contract(cores=g(cores, jnp.int32(0)), mem=g(mem, jnp.int32(0)),
+                    time_ms=g(time_ms, jnp.int32(0)), price=g(price, jnp.float32(0.0)))

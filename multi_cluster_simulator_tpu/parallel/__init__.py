@@ -1,0 +1,5 @@
+from multi_cluster_simulator_tpu.parallel.exchange import Exchange, LocalExchange, MeshExchange
+from multi_cluster_simulator_tpu.parallel.mesh import make_mesh
+from multi_cluster_simulator_tpu.parallel.sharded_engine import ShardedEngine
+
+__all__ = ["Exchange", "LocalExchange", "MeshExchange", "make_mesh", "ShardedEngine"]

@@ -1,0 +1,71 @@
+"""The cross-cluster communication boundary.
+
+The reference's cross-cluster fabric is goroutine fan-out over HTTP/gRPC with
+first-response-wins races (BorrowResources, pkg/scheduler/server.go:183-243;
+Trade, pkg/trader/trader.go:211-258). In the TPU engine every cross-cluster
+decision is already a batched array op over the cluster axis; this module
+abstracts the three collective primitives those ops need so the same engine
+code runs single-device (identity ops) or sharded over a device mesh
+(XLA collectives over ICI):
+
+- ``gather``     — see every cluster's request row   (lax.all_gather)
+- ``allmin``     — global minimum across shards       (lax.pmin)
+- ``offset``     — my shard's global cluster offset   (lax.axis_index)
+
+This is the idiomatic-TPU replacement for NCCL/MPI-style messaging: the
+borrow broadcast becomes an all-gather of feasibility bits and the market's
+offer collection a min-reduction over seller indices (SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Exchange:
+    """Interface; see LocalExchange / MeshExchange."""
+
+    def gather(self, x):
+        raise NotImplementedError
+
+    def allmin(self, x):
+        raise NotImplementedError
+
+    def offset(self, c_local: int):
+        raise NotImplementedError
+
+    def global_index(self, c_local: int):
+        """Global cluster indices of this shard's local clusters."""
+        return self.offset(c_local) + jnp.arange(c_local, dtype=jnp.int32)
+
+
+class LocalExchange(Exchange):
+    """Single-device: the cluster axis is whole; collectives are identities."""
+
+    def gather(self, x):
+        return x
+
+    def allmin(self, x):
+        return x
+
+    def offset(self, c_local: int):
+        return jnp.int32(0)
+
+
+class MeshExchange(Exchange):
+    """Inside ``shard_map`` over a mesh axis: per-shard arrays carry
+    ``C_local = C_total / n_shards`` clusters; decisions that need every
+    cluster's row ride ICI collectives."""
+
+    def __init__(self, axis_name: str = "clusters"):
+        self.axis_name = axis_name
+
+    def gather(self, x):
+        return jax.lax.all_gather(x, self.axis_name, axis=0, tiled=True)
+
+    def allmin(self, x):
+        return jax.lax.pmin(x, self.axis_name)
+
+    def offset(self, c_local: int):
+        return (jax.lax.axis_index(self.axis_name) * c_local).astype(jnp.int32)

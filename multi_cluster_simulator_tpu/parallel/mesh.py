@@ -1,0 +1,26 @@
+"""Device-mesh construction for the cluster axis.
+
+One mesh axis ("clusters") — the simulator's scale axis is clusters, the
+analogue of the reference running one scheduler process per cluster
+(cmd/scheduler). Sharding the cluster axis places each device's cluster
+shard entirely locally; the only ICI traffic is the per-tick borrow/trade
+decision exchange (parallel/exchange.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "clusters",
+              devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))

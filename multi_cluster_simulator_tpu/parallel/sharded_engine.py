@@ -1,0 +1,107 @@
+"""Multi-device engine: the cluster axis sharded over a jax Mesh.
+
+The reference scales out by launching one scheduler+trader OS process per
+cluster and wiring them over HTTP/gRPC (cmd/, SURVEY.md §1). Here scale-out
+is a sharding annotation: every per-cluster tensor is split over the mesh's
+"clusters" axis, the per-cluster phases run locally on each device, and the
+three cross-cluster decisions exchange compact rows over ICI
+(parallel/exchange.py). The same Engine code runs in both regimes — shard_map
+just swaps the exchange implementation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.core.engine import Engine
+from multi_cluster_simulator_tpu.core.state import Arrivals, SimState
+from multi_cluster_simulator_tpu.parallel.exchange import MeshExchange
+
+
+def _state_specs(axis: str):
+    """Pytree prefix: every per-cluster field sharded on its leading axis,
+    the scalar clock replicated."""
+    shard, rep = P(axis), P()
+    return SimState(
+        t=rep, node_cap=shard, node_free=shard, node_active=shard,
+        node_expire=shard, l0=shard, l1=shard, ready=shard, wait=shard,
+        lent=shard, borrowed=shard, run=shard, arr_ptr=shard,
+        wait_total=shard, wait_jobs=shard, jobs_in_queue=shard,
+        placed_total=shard, trader=shard, trace=shard)
+
+
+def _arr_specs(axis: str):
+    shard = P(axis)
+    return Arrivals(t=shard, id=shard, cores=shard, mem=shard, dur=shard, n=shard)
+
+
+class ShardedEngine:
+    """Engine whose cluster axis is sharded over ``mesh``'s first axis.
+
+    The number of clusters must be divisible by the mesh size. Use
+    ``shard_inputs`` to place host-built state/arrivals onto the mesh.
+    """
+
+    def __init__(self, cfg: SimConfig, mesh: Mesh, axis: str = "clusters"):
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no axis {axis!r}: {mesh.axis_names}")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.engine = Engine(cfg, ex=MeshExchange(axis))
+
+    def shard_inputs(self, state: SimState, arrivals: Arrivals):
+        n = self.mesh.shape[self.axis]
+        C = state.arr_ptr.shape[0]
+        if C % n != 0:
+            raise ValueError(f"clusters ({C}) must divide by mesh size ({n})")
+        state = _device_put_tree(state, _state_specs(self.axis), self.mesh)
+        arrivals = _device_put_tree(arrivals, _arr_specs(self.axis), self.mesh)
+        return state, arrivals
+
+    def run_fn(self, n_ticks: int):
+        """A jitted (state, arrivals) -> state advancing n_ticks under
+        shard_map."""
+        eng = self.engine
+
+        def body(state, arrivals):
+            return eng.run(state, arrivals, n_ticks)
+
+        mapped = jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(_state_specs(self.axis), _arr_specs(self.axis)),
+            out_specs=_state_specs(self.axis),
+            check_vma=False)
+        return jax.jit(mapped)
+
+
+def _device_put_tree(tree, spec_prefix, mesh):
+    """device_put each array leaf with the sharding from a pytree-prefix of
+    PartitionSpecs (a prefix node applies to the whole subtree beneath it)."""
+    flat_specs = _expand_prefix(spec_prefix, tree)
+    leaves, treedef = jax.tree.flatten(tree)
+    out = [jax.device_put(x, NamedSharding(mesh, s))
+           for x, s in zip(leaves, flat_specs)]
+    return jax.tree.unflatten(treedef, out)
+
+
+def _expand_prefix(prefix, tree):
+    """Expand a pytree prefix of PartitionSpecs to one spec per leaf."""
+    out = []
+
+    def rec(p, t):
+        if isinstance(p, P):
+            out.extend([p] * len(jax.tree.leaves(t)))
+        else:
+            pk = jax.tree.structure(p, is_leaf=lambda x: isinstance(x, P))
+            ps = jax.tree.leaves(p, is_leaf=lambda x: isinstance(x, P))
+            ts = pk.flatten_up_to(t)
+            for pp, tt in zip(ps, ts):
+                rec(pp, tt)
+
+    rec(prefix, tree)
+    return out

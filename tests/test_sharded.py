@@ -1,0 +1,94 @@
+"""Sharded engine: the 8-virtual-device mesh must produce bit-identical
+results to the single-device engine (and hence to the oracle) — collectives
+replacing the identity exchange must not change any decision."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from multi_cluster_simulator_tpu.config import (
+    PolicyKind, SimConfig, TraderConfig, WorkloadConfig,
+)
+from multi_cluster_simulator_tpu.core.engine import Engine
+from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
+from multi_cluster_simulator_tpu.utils.trace import check_conservation, extract_trace
+from tests.conftest import make_arrivals
+
+
+def _assert_states_equal(a, b):
+    fa, _ = jax.tree.flatten(a)
+    fb, _ = jax.tree.flatten(b)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _specs(C):
+    # a mix of capacities so borrowing/trading has structure
+    out = []
+    for c in range(C):
+        if c % 4 == 3:
+            out.append(uniform_cluster(c + 1, 10))  # big idle-ish lender
+        else:
+            out.append(uniform_cluster(c + 1, 3, cores=16, memory=8_000))
+    return out
+
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_fifo_borrowing_sharded_matches_local(n_dev):
+    cfg = SimConfig(policy=PolicyKind.FIFO, borrowing=True, record_trace=True,
+                    queue_capacity=128, max_running=256, max_arrivals=1024,
+                    max_nodes=12, workload=WorkloadConfig(poisson_lambda_per_min=30.0))
+    C = 8
+    specs = _specs(C)
+    arrivals = make_arrivals(cfg, C, horizon_ms=120_000, seed=31,
+                             max_cores=16, max_mem=8_000)
+    state0 = init_state(cfg, specs)
+
+    local = Engine(cfg).run_jit()(state0, arrivals, 120)
+
+    mesh = make_mesh(n_dev)
+    sh = ShardedEngine(cfg, mesh)
+    sstate, sarr = sh.shard_inputs(state0, arrivals)
+    sharded = sh.run_fn(120)(sstate, sarr)
+    _assert_states_equal(local, sharded)
+    check_conservation(sharded)
+
+
+def test_delay_trader_sharded_matches_local():
+    cfg = SimConfig(policy=PolicyKind.DELAY, record_trace=True,
+                    queue_capacity=256, max_running=256, max_arrivals=2048,
+                    max_nodes=12, max_virtual_nodes=4,
+                    trader=TraderConfig(enabled=True),
+                    workload=WorkloadConfig(poisson_lambda_per_min=40.0))
+    C = 8
+    specs = _specs(C)
+    arrivals = make_arrivals(cfg, C, horizon_ms=200_000, seed=32,
+                             max_cores=16, max_mem=8_000)
+    # quiet the big clusters so they act as sellers
+    n = np.asarray(arrivals.n).copy()
+    n[3::4] = 0
+    arrivals = arrivals.replace(n=n)
+    state0 = init_state(cfg, specs)
+
+    local = Engine(cfg).run_jit()(state0, arrivals, 200)
+    assert any(np.asarray(local.node_active)[:, cfg.max_nodes]), \
+        "expected the market to create a virtual node"
+
+    mesh = make_mesh(8)
+    sh = ShardedEngine(cfg, mesh)
+    sstate, sarr = sh.shard_inputs(state0, arrivals)
+    sharded = sh.run_fn(200)(sstate, sarr)
+    _assert_states_equal(local, sharded)
+
+
+def test_cluster_count_must_divide():
+    cfg = SimConfig(policy=PolicyKind.DELAY, max_nodes=12)
+    specs = _specs(6)
+    arrivals = make_arrivals(cfg, 6, horizon_ms=10_000, seed=1)
+    sh = ShardedEngine(cfg, make_mesh(8))
+    with pytest.raises(ValueError, match="divide"):
+        sh.shard_inputs(init_state(cfg, specs), arrivals)
