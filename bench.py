@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Scale harness — the BASELINE.json benchmark configs.
+
+Prints ONE JSON line for the driver:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Default (no args) runs the headline north-star config: 1M+ jobs across 4096
+clusters through the FIFO engine in parity semantics. ``vs_baseline`` is
+measured against the north-star target of 1M jobs in 60 s wall
+(BASELINE.json): vs_baseline = achieved jobs/s ÷ (1e6/60). The reference
+itself is wall-clock-bound (jobs sleep their duration,
+pkg/scheduler/cluster.go:151), so it would need the full ~1560 s of
+simulated time — per-config speedups vs that bound are in the details file.
+
+Usage:
+  python bench.py                 # headline (north star)
+  python bench.py --config NAME   # fifo_small | fifo_two_trader | ffd64 |
+                                  # borg4k | headline
+  python bench.py --all           # every config; details to bench_results.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200):
+    """Advance n_ticks in jitted chunks (one device call per chunk — a single
+    multi-minute executable can trip device RPC deadlines)."""
+    import jax
+
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.core.state import init_state
+
+    state = init_state(cfg, specs)
+    n_dev = len(jax.devices())
+    chunks = [chunk] * (n_ticks // chunk)
+    if n_ticks % chunk:
+        chunks.append(n_ticks % chunk)
+    if use_mesh and n_dev > 1 and state.arr_ptr.shape[0] % n_dev == 0:
+        from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
+        sh = ShardedEngine(cfg, make_mesh(n_dev))
+        state, arrivals = sh.shard_inputs(state, arrivals)
+        fns = {n: sh.run_fn(n) for n in set(chunks)}
+        step = lambda s, n: fns[n](s, arrivals)
+    else:
+        eng = Engine(cfg)
+        jfn = jax.jit(eng.run, static_argnums=(2,))
+        step = lambda s, n: jfn(s, arrivals, n)
+
+    def run(s):
+        for n in chunks:
+            s = step(s, n)
+        return jax.block_until_ready(s)
+
+    t0 = time.time()
+    out = run(state)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    out = run(state)
+    wall_s = time.time() - t0
+    return out, wall_s, compile_s
+
+
+def bench_headline(quick=False):
+    """North star: 1M+ jobs x 4096 clusters, FIFO parity semantics."""
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+    C = 256 if quick else 4096
+    jobs_per = 250  # C * jobs_per >= 1M at full scale
+    horizon_ms = 1_500_000
+    # fast mode: drain cap 16/tick — identical to parity semantics whenever
+    # fewer than 16 jobs drain in one tick (arrival rate here is ~0.17/tick)
+    cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=64, max_running=128,
+                    max_arrivals=jobs_per, max_ingest_per_tick=16,
+                    parity=False, max_placements_per_tick=16,
+                    max_nodes=5, max_virtual_nodes=0)
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]  # cluster_small shape
+    arrivals = uniform_stream(C, jobs_per, horizon_ms, max_cores=8,
+                              max_mem=6_000, max_dur_ms=60_000, seed=9)
+    n_ticks = horizon_ms // cfg.tick_ms + 70  # drain tail
+    out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks,
+                                         use_mesh=True)
+    placed = int(np.asarray(out.placed_total).sum())
+    total = C * jobs_per
+    assert placed >= 0.99 * total, f"only {placed}/{total} jobs placed"
+    jobs_per_sec = placed / wall_s
+    return {
+        "metric": "sim_jobs_per_sec_1M_jobs_4k_clusters",
+        "value": round(jobs_per_sec, 1),
+        "unit": "jobs/s",
+        "vs_baseline": round(jobs_per_sec / (1_000_000 / 60.0), 3),
+        "detail": {"jobs": placed, "clusters": C, "wall_s": round(wall_s, 3),
+                   "compile_s": round(compile_s, 1), "ticks": n_ticks,
+                   "sim_horizon_s": n_ticks,
+                   "speedup_vs_wallclock_reference": round(n_ticks / wall_s, 1)},
+    }
+
+
+def bench_fifo_small():
+    """Config 1: FIFO, single cluster, cluster_small, reference workload."""
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.workload import generate_arrivals
+
+    cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=128,
+                    max_running=512, max_arrivals=2048, max_nodes=5)
+    n_ticks = 3600
+    arrivals = generate_arrivals(cfg.workload, 1, cfg.max_arrivals,
+                                 n_ticks * 1000, 32, 24_000, seed=9)
+    out, wall_s, compile_s = _engine_run(cfg, [uniform_cluster(1, 5)],
+                                         arrivals, n_ticks)
+    return {
+        "metric": "fifo_cluster_small_ticks_per_sec",
+        "value": round(n_ticks / wall_s, 1),
+        "unit": "virtual-s/s",
+        "vs_baseline": round(n_ticks / wall_s, 1),  # Go runs 1 virtual-s/s
+        "detail": {"wall_s": round(wall_s, 3), "compile_s": round(compile_s, 1),
+                   "placed": int(np.asarray(out.placed_total).sum())},
+    }
+
+
+def bench_fifo_two_trader():
+    """Config 2: FIFO, cluster_small + cluster_big, borrowing + trader on."""
+    from multi_cluster_simulator_tpu.config import (
+        PolicyKind, SimConfig, TraderConfig, WorkloadConfig,
+    )
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.workload import generate_arrivals
+
+    cfg = SimConfig(policy=PolicyKind.FIFO, borrowing=True, queue_capacity=256,
+                    max_running=512, max_arrivals=4096, max_nodes=10,
+                    trader=TraderConfig(enabled=True),
+                    workload=WorkloadConfig(poisson_lambda_per_min=30.0))
+    n_ticks = 1800
+    arrivals = generate_arrivals(cfg.workload, 2, cfg.max_arrivals,
+                                 n_ticks * 1000, 32, 24_000, seed=9)
+    specs = [uniform_cluster(1, 5), uniform_cluster(2, 10)]
+    out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks)
+    return {
+        "metric": "fifo_two_cluster_trader_ticks_per_sec",
+        "value": round(n_ticks / wall_s, 1),
+        "unit": "virtual-s/s",
+        "vs_baseline": round(n_ticks / wall_s, 1),
+        "detail": {"wall_s": round(wall_s, 3), "compile_s": round(compile_s, 1),
+                   "placed": int(np.asarray(out.placed_total).sum()),
+                   "borrowed": int(np.asarray(out.borrowed.count).sum())},
+    }
+
+
+def bench_ffd64(quick=False):
+    """Config 3: first-fit-decreasing bin-pack, 64 clusters x 10k jobs."""
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+    C, jobs_per = (8, 2_000) if quick else (64, 10_000)
+    horizon_ms = 1_000_000
+    cfg = SimConfig(policy=PolicyKind.FFD, parity=False,
+                    max_placements_per_tick=32, queue_capacity=512,
+                    max_running=1024, max_arrivals=jobs_per,
+                    max_ingest_per_tick=64, max_nodes=10, max_virtual_nodes=0)
+    specs = [uniform_cluster(c + 1, 10) for c in range(C)]
+    arrivals = uniform_stream(C, jobs_per, horizon_ms, max_cores=4,
+                              max_mem=3_000, max_dur_ms=30_000, seed=3)
+    n_ticks = horizon_ms // 1000 + 100
+    out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks,
+                                         use_mesh=True)
+    placed = int(np.asarray(out.placed_total).sum())
+    assert placed >= 0.95 * C * jobs_per, f"only {placed}/{C * jobs_per} placed"
+    return {
+        "metric": "ffd_binpack_jobs_per_sec_64x10k",
+        "value": round(placed / wall_s, 1),
+        "unit": "jobs/s",
+        "vs_baseline": round((placed / wall_s) / (1_000_000 / 60.0), 3),
+        "detail": {"jobs": placed, "wall_s": round(wall_s, 3),
+                   "compile_s": round(compile_s, 1)},
+    }
+
+
+def bench_borg4k(quick=False):
+    """Config 5: Borg-2019-shaped trace replay, 4k clusters, mesh-sharded
+    when more than one device is available."""
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.workload.traces import borg_like_stream
+
+    C = 256 if quick else 4096
+    jobs_per = 250
+    horizon_ms = 1_500_000
+    cfg = SimConfig(policy=PolicyKind.FFD, parity=False,
+                    max_placements_per_tick=16, queue_capacity=128,
+                    max_running=256, max_arrivals=jobs_per,
+                    max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=0)
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    arrivals = borg_like_stream(C, jobs_per, horizon_ms, max_cores=32,
+                                max_mem=24_000, seed=19)
+    n_ticks = horizon_ms // 1000 + 100
+    out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks,
+                                         use_mesh=True)
+    placed = int(np.asarray(out.placed_total).sum())
+    return {
+        "metric": "borg_like_replay_jobs_per_sec_4k_clusters",
+        "value": round(placed / wall_s, 1),
+        "unit": "jobs/s",
+        "vs_baseline": round((placed / wall_s) / (1_000_000 / 60.0), 3),
+        "detail": {"jobs": placed, "of": C * jobs_per,
+                   "wall_s": round(wall_s, 3), "compile_s": round(compile_s, 1)},
+    }
+
+
+CONFIGS = {
+    "headline": bench_headline,
+    "fifo_small": bench_fifo_small,
+    "fifo_two_trader": bench_fifo_two_trader,
+    "ffd64": bench_ffd64,
+    "borg4k": bench_borg4k,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="headline", choices=sorted(CONFIGS))
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--quick", action="store_true",
+                    help="shrunk shapes for smoke-testing the harness")
+    args = ap.parse_args()
+
+    def run_one(name):
+        fn = CONFIGS[name]
+        try:
+            return fn(quick=args.quick)
+        except TypeError:
+            return fn()
+
+    if args.all:
+        results = {}
+        for name in CONFIGS:
+            results[name] = run_one(name)
+            print(f"# {name}: {results[name]['metric']} = "
+                  f"{results[name]['value']} {results[name]['unit']}",
+                  file=sys.stderr)
+        with open("bench_results.json", "w") as f:
+            json.dump(results, f, indent=2)
+        head = dict(results["headline"])
+    else:
+        head = run_one(args.config)
+
+    detail = head.pop("detail", None)
+    if detail is not None:
+        print(f"# detail: {json.dumps(detail)}", file=sys.stderr)
+    print(json.dumps(head))
+
+
+if __name__ == "__main__":
+    main()

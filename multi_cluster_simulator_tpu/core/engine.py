@@ -91,6 +91,14 @@ def _attempt(s: SimState, job: Q.JobRec, t, do, src, record_trace: bool):
     return s, success
 
 
+def _sweep_len(cfg: SimConfig) -> int:
+    """Per-tick placement-sweep length: the whole queue in parity mode, the
+    fast-mode cap otherwise (PARITY.md §divergences)."""
+    if cfg.parity:
+        return cfg.queue_capacity
+    return min(cfg.queue_capacity, cfg.max_placements_per_tick)
+
+
 def _record_wait(total, rec_wait, enq_t, t, do):
     """JobsMap bookkeeping on a scheduling attempt (scheduler.go:309-312):
     TotalTime -= map[id]; map[id] = since(enqueue); TotalTime += map[id]."""
@@ -132,28 +140,25 @@ def _deliver_returns(state: SimState, run, done, cfg: SimConfig, ex) -> SimState
     # owner >= 0 is a borrower cluster; FOREIGN (-2) trader placeholders are
     # returned to nobody (Go posts to the literal URL "Foreign" and gives up)
     is_ret = jnp.logical_and(done, run.owner >= 0)  # [C_loc, S]
-    # first M returning slots per cluster
+    # first M returning slots per cluster, as packed rows
     order = jnp.argsort(jnp.logical_not(is_ret), axis=1, stable=True)[:, :M]
     take = jnp.take_along_axis(is_ret, order, axis=1)  # [C_loc, M]
-    f = lambda a: ex.gather(jnp.take_along_axis(a, order, axis=1)).reshape(-1)
+    rows = jnp.take_along_axis(run.data, order[..., None], axis=1)  # [C_loc, M, RF]
     # dst = global borrower index; -1 marks an empty message slot
-    msg_dst = ex.gather(
-        jnp.where(take, jnp.take_along_axis(run.owner, order, axis=1), -1)
-    ).reshape(-1)  # [C_tot*M]
-    msg_id, msg_cores = f(run.id), f(run.cores)
-    msg_mem, msg_dur = f(run.mem), f(run.dur)
+    dst_local = jnp.where(take, rows[..., R.ROWNER], -1)
+    msg_dst = ex.gather(dst_local).reshape(-1)  # [C_tot*M]
+    msg_rows = ex.gather(rows).reshape(-1, R.RF)
     n_msgs = msg_dst.shape[0]
     gidx = ex.global_index(C_loc)
 
     def remove_for_cluster(borrowed_q, c):
-        def eq(q, m):
-            hit = jnp.logical_and(
-                jnp.logical_and(q.id == msg_id[m], q.cores == msg_cores[m]),
-                jnp.logical_and(q.mem == msg_mem[m], q.dur == msg_dur[m]))
-            return jnp.logical_and(hit, msg_dst[m] == c)
-
         def body(q, m):
-            matched = jnp.logical_and(eq(q, m), q.slot_valid())
+            row = msg_rows[m]
+            hit = jnp.logical_and(
+                jnp.logical_and(q.id == row[R.RID], q.cores == row[R.RCORES]),
+                jnp.logical_and(q.mem == row[R.RMEM], q.dur == row[R.RDUR]))
+            matched = jnp.logical_and(
+                jnp.logical_and(hit, msg_dst[m] == c), q.slot_valid())
             return Q.compact(q, jnp.logical_not(matched)), None
 
         q, _ = jax.lax.scan(body, borrowed_q, jnp.arange(n_msgs, dtype=jnp.int32))
@@ -176,19 +181,19 @@ def _ingest_local(s: SimState, arr: Arrivals, t, cfg: SimConfig, to_delay: bool)
     idx = s.arr_ptr + jnp.arange(K, dtype=jnp.int32)
     safe = jnp.clip(idx, 0, arr.t.shape[-1] - 1)
     valid = jnp.logical_and(idx < arr.n, arr.t[safe] <= t)  # prefix mask (sorted)
-    rows = Q.JobQueue(
+    rows = Q.from_fields(
         id=arr.id[safe], cores=arr.cores[safe], mem=arr.mem[safe],
         dur=arr.dur[safe], enq_t=arr.t[safe],
         owner=jnp.full((K,), Q.OWN, jnp.int32),
         rec_wait=jnp.zeros((K,), jnp.int32),
-        count=jnp.sum(valid).astype(jnp.int32),
+        count=jnp.sum(valid),
     )
     n = rows.count
     if to_delay:
-        q = Q.push_many(s.l0, rows, valid)
+        q = Q.push_many(s.l0, rows, valid, prefix=True)
         s = s.replace(l0=q, wait_jobs=s.wait_jobs + n, jobs_in_queue=s.jobs_in_queue + n)
     else:
-        q = Q.push_many(s.ready, rows, valid)
+        q = Q.push_many(s.ready, rows, valid, prefix=True)
         s = s.replace(ready=q)
     return s.replace(arr_ptr=s.arr_ptr + n)
 
@@ -198,14 +203,20 @@ def _ingest_local(s: SimState, arr: Arrivals, t, cfg: SimConfig, to_delay: bool)
 # --------------------------------------------------------------------------
 
 def _delay_local(s: SimState, t, cfg: SimConfig):
-    """Delay() — the reference's live algorithm (scheduler.go:298-369)."""
-    QC = cfg.queue_capacity
+    """Delay() — the reference's live algorithm (scheduler.go:298-369).
+
+    In fast mode (parity=False) the Level1 sweep attempts only the first
+    ``max_placements_per_tick`` queue slots — a throughput knob for scale
+    configs (PARITY.md §divergences); the queue still drains in FIFO order
+    via compaction."""
+    QC = cfg.queue_capacity if cfg.parity else min(
+        cfg.queue_capacity, cfg.max_placements_per_tick)
 
     # ---- Level1 sweep ----
     def step(carry, i):
         s, rec, placed, skip_next = carry
         process = jnp.logical_and(i < s.l1.count, jnp.logical_not(skip_next))
-        job = Q.get(s.l1, i).replace(rec_wait=rec[i])
+        job = Q.get(s.l1, i).with_(rec_wait=rec[i])
         total, new_rec = _record_wait(s.wait_total, rec[i], job.enq_t, t, process)
         rec = rec.at[i].set(new_rec)
         s = s.replace(wait_total=total)
@@ -218,18 +229,19 @@ def _delay_local(s: SimState, t, cfg: SimConfig):
         skip_next = success if cfg.parity else jnp.zeros((), bool)
         return (s, rec, placed, skip_next), None
 
-    init = (s, s.l1.rec_wait, jnp.zeros((QC,), bool), jnp.zeros((), bool))
+    init = (s, s.l1.rec_wait, jnp.zeros((cfg.queue_capacity,), bool),
+            jnp.zeros((), bool))
     (s, rec, placed, _), _ = jax.lax.scan(step, init, jnp.arange(QC, dtype=jnp.int32))
-    l1 = Q.compact(s.l1.replace(rec_wait=rec), jnp.logical_not(placed))
+    l1 = Q.compact(Q.set_col(s.l1, Q.FREC, rec), jnp.logical_not(placed))
     s = s.replace(l1=l1)
 
     # ---- Level0 head ----
     process = s.l0.count > 0
     job = Q.head(s.l0)
     total, new_rec = _record_wait(s.wait_total, job.rec_wait, job.enq_t, t, process)
-    l0 = s.l0.replace(rec_wait=s.l0.rec_wait.at[0].set(new_rec))
+    l0 = s.l0.replace(data=s.l0.data.at[0, Q.FREC].set(new_rec))
     s = s.replace(wait_total=total, l0=l0)
-    job = job.replace(rec_wait=new_rec)
+    job = job.with_(rec_wait=new_rec)
     s, success = _attempt(s, job, t, process, st.SRC_L0, cfg.record_trace)
     s = s.replace(jobs_in_queue=s.jobs_in_queue - success.astype(jnp.int32))
     promote = jnp.logical_and(
@@ -245,8 +257,10 @@ def _delay_local(s: SimState, t, cfg: SimConfig):
 
 def _ffd_local(s: SimState, t, cfg: SimConfig):
     """First-fit-decreasing bin-pack over Level0 — one XLA sort + the shared
-    placement sweep. Not in the reference; BASELINE.json config 3."""
-    QC = cfg.queue_capacity
+    placement sweep. Not in the reference; BASELINE.json config 3. Fast mode
+    caps the sweep at ``max_placements_per_tick`` (largest jobs first)."""
+    QC = cfg.queue_capacity if cfg.parity else min(
+        cfg.queue_capacity, cfg.max_placements_per_tick)
     order = P.best_fit_decreasing_order(s.l0.cores, s.l0.mem, s.l0.slot_valid())
 
     def step(carry, k):
@@ -256,13 +270,13 @@ def _ffd_local(s: SimState, t, cfg: SimConfig):
         job = Q.get(s.l0, i)
         total, new_rec = _record_wait(s.wait_total, job.rec_wait, job.enq_t, t, process)
         s = s.replace(wait_total=total,
-                      l0=s.l0.replace(rec_wait=s.l0.rec_wait.at[i].set(new_rec)))
+                      l0=s.l0.replace(data=s.l0.data.at[i, Q.FREC].set(new_rec)))
         s, success = _attempt(s, job, t, process, st.SRC_L0, cfg.record_trace)
         s = s.replace(jobs_in_queue=s.jobs_in_queue - success.astype(jnp.int32))
         placed = placed.at[i].set(success)
         return (s, placed), None
 
-    (s, placed), _ = jax.lax.scan(step, (s, jnp.zeros((QC,), bool)),
+    (s, placed), _ = jax.lax.scan(step, (s, jnp.zeros((cfg.queue_capacity,), bool)),
                                   jnp.arange(QC, dtype=jnp.int32))
     return s.replace(l0=Q.compact(s.l0, jnp.logical_not(placed)))
 
@@ -270,8 +284,13 @@ def _ffd_local(s: SimState, t, cfg: SimConfig):
 def _fifo_local(s: SimState, t, cfg: SimConfig):
     """Fifo() (scheduler.go:216-296) as ordered masked phases; see PARITY.md
     for the derivation of the per-tick semantics from the Go loop's
-    sleep/continue structure. Returns (state, borrow_want, borrow_job)."""
-    QC = cfg.queue_capacity
+    sleep/continue structure. Returns (state, borrow_want, borrow_job).
+
+    Fast mode (parity=False) caps the ready drain at
+    ``max_placements_per_tick`` steps — identical semantics whenever fewer
+    than that many jobs would drain in one tick (PARITY.md §divergences)."""
+    QC = cfg.queue_capacity if cfg.parity else min(
+        cfg.queue_capacity, cfg.max_placements_per_tick)
     wait_active = s.wait.count > 0
 
     # ---- ready drain (only when the wait queue is empty): place from the
@@ -293,7 +312,8 @@ def _fifo_local(s: SimState, t, cfg: SimConfig):
             jnp.zeros((), bool))
     (s, _, taken, fail_job, any_fail), _ = jax.lax.scan(
         dstep, init, jnp.arange(QC, dtype=jnp.int32))
-    s = s.replace(ready=Q.compact(s.ready, jnp.logical_not(taken)),
+    # the drain consumes a strict prefix of the ready queue
+    s = s.replace(ready=Q.pop_front_n(s.ready, jnp.sum(taken).astype(jnp.int32)),
                   wait=Q.push_back(s.wait, fail_job, any_fail))
 
     # ---- wait-head attempt (the branch at scheduler.go:219-252) ----
@@ -337,7 +357,7 @@ def _borrow_match(state: SimState, want, jobs: Q.JobRec, cfg: SimConfig, ex) -> 
     # feas[l_local, b_global]: can my lender l host borrower b's job?
     def lender_view(free_l, active_l):
         return jax.vmap(lambda c, m: P.can_lend(
-            free_l, active_l, Q.JobRec.invalid().replace(cores=c, mem=m))
+            free_l, active_l, Q.JobRec.make(cores=c, mem=m))
         )(g_jobs.cores, g_jobs.mem)
 
     feas = jax.vmap(lender_view)(state.node_free, state.node_active)
@@ -351,7 +371,7 @@ def _borrow_match(state: SimState, want, jobs: Q.JobRec, cfg: SimConfig, ex) -> 
     # Borrower side (local): j.Ownership = own URL (server.go:166), push to
     # BorrowedQueue, pop WaitQueue (scheduler.go:239-242).
     matched_loc = jnp.logical_and(matched_g[gidx], want)
-    owned = jobs.replace(owner=gidx)
+    owned = jobs.with_(owner=gidx)
 
     def borrower_update(s_wait, s_borrowed, job, m):
         return Q.pop_front(s_wait, m), Q.push_back(s_borrowed, job, m)
@@ -362,10 +382,8 @@ def _borrow_match(state: SimState, want, jobs: Q.JobRec, cfg: SimConfig, ex) -> 
     # Lender side (local): append to LentQueue (server.go:94-107). Several
     # borrowers may win the same lender in one tick (the Go handler takes
     # them all); deliver in global borrower-index order.
-    send_rows = Q.JobQueue(
-        id=g_jobs.id, cores=g_jobs.cores, mem=g_jobs.mem, dur=g_jobs.dur,
-        enq_t=g_jobs.enq_t, owner=bidx, rec_wait=g_jobs.rec_wait,
-        count=jnp.sum(matched_g).astype(jnp.int32))
+    send_rows = Q.JobQueue(data=g_jobs.with_(owner=bidx).vec,
+                           count=jnp.sum(matched_g).astype(jnp.int32))
 
     def lender_update(lent_q, gl):
         take = jnp.logical_and(matched_g, winner == gl)

@@ -145,7 +145,9 @@ def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec]) -> SimState:
 
     zf = jnp.zeros((C,), jnp.float32)
     zi = jnp.zeros((C,), jnp.int32)
-    E = cfg.max_trace_events
+    # trace buffers are only materialized when recording (at 4k clusters a
+    # full-size buffer would be GBs of HBM)
+    E = cfg.max_trace_events if cfg.record_trace else 1
     never = jnp.full((C, N), R.NEVER, jnp.int32)
     return SimState(
         t=jnp.int32(0),

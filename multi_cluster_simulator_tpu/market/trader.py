@@ -154,13 +154,12 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
                                                       amts[n, MEM] > 0))
             slot = jnp.argmin(rn.active).astype(jnp.int32)
             ok = jnp.logical_and(occ, jnp.logical_not(rn.active[slot]))
-            w = lambda a, v: a.at[slot].set(jnp.where(ok, v, a[slot]))
+            row = R.make_row(t + ccon.time_ms, n, amts[n, CORES], amts[n, MEM],
+                             PLACEHOLDER_ID, FOREIGN, ccon.time_ms, t)
             return R.RunningSet(
-                end_t=w(rn.end_t, t + ccon.time_ms), node=w(rn.node, n),
-                cores=w(rn.cores, amts[n, CORES]), mem=w(rn.mem, amts[n, MEM]),
-                id=w(rn.id, PLACEHOLDER_ID), owner=w(rn.owner, FOREIGN),
-                dur=w(rn.dur, ccon.time_ms), enq_t=w(rn.enq_t, t),
-                active=w(rn.active, ok)), None
+                data=rn.data.at[slot].set(jnp.where(ok, row, rn.data[slot])),
+                active=rn.active.at[slot].set(
+                    jnp.where(ok, True, rn.active[slot]))), None
 
         N = free.shape[0]
         run, _ = jax.lax.scan(add_placeholder, run, jnp.arange(N, dtype=jnp.int32))

@@ -51,9 +51,8 @@ def can_lend(free: jax.Array, active: jax.Array, job: JobRec) -> jax.Array:
 def occupy(free: jax.Array, node: jax.Array, job: JobRec, do: jax.Array) -> jax.Array:
     """Subtract job resources from ``free[node]`` when ``do``. (RunJob's
     decrement half, cluster.go:144-148.)"""
-    delta = jnp.stack([job.cores, job.mem]).astype(jnp.int32)
     idx = jnp.where(do, node, 0)
-    return free.at[idx, :].add(jnp.where(do, -delta, 0))
+    return free.at[idx, :].add(jnp.where(do, -job.res, 0))
 
 
 def best_fit_decreasing_order(q_cores: jax.Array, q_mem: jax.Array, valid: jax.Array) -> jax.Array:

@@ -2,13 +2,15 @@
 
 The reference keeps six mutex-guarded Go slices per scheduler (ReadyQueue,
 WaitQueue, LentQueue, BorrowedQueue, Level0, Level1 —
-pkg/scheduler/scheduler.go:19-30). Here a queue is a struct-of-arrays pytree
-with a scalar ``count``: valid entries occupy slots ``[0, count)`` in FIFO
-order, so "head" is slot 0 and append writes at slot ``count``. All ops are
-pure, static-shape, and written for a single cluster — the engine ``vmap``s
-them over the cluster axis.
+pkg/scheduler/scheduler.go:19-30). Here a queue is ONE packed int32 tensor
+``data[Q, NF]`` plus a scalar ``count``: valid entries occupy rows
+``[0, count)`` in FIFO order, so "head" is row 0 and append writes row
+``count``. The packed layout matters: queue ops (gather/scatter/roll/where)
+touch one tensor instead of seven, and at 4k clusters per-op dispatch — not
+FLOPs — is the tick-loop cost. All ops are pure, static-shape, and written
+for a single cluster — the engine ``vmap``s them over the cluster axis.
 
-Job fields mirror the reference's ``Job`` struct (scheduler.go:65-73):
+Row fields mirror the reference's ``Job`` struct (scheduler.go:65-73):
 id, cores, mem, duration, enqueue-time (``WaitTime time.Time``), owner
 (``Ownership string`` — here the borrower's cluster index, -1 for "my own
 job"), plus ``rec_wait``, the last wait recorded in the scheduler's
@@ -26,64 +28,129 @@ from flax import struct
 INVALID_ID = jnp.int32(-1)
 OWN = jnp.int32(-1)  # owner value for "my own job" (Ownership == "")
 
+# packed row layout
+NF = 7
+FID, FCORES, FMEM, FDUR, FENQ, FOWNER, FREC = range(NF)
+
+_INVALID_ROW = jnp.array([-1, 0, 0, 0, 0, -1, 0], jnp.int32)  # id=-1, owner=OWN
+
+
+@struct.dataclass
+class JobRec:
+    """A single job: one packed [NF] int32 row."""
+
+    vec: jax.Array
+
+    @property
+    def id(self):
+        return self.vec[..., FID]
+
+    @property
+    def cores(self):
+        return self.vec[..., FCORES]
+
+    @property
+    def mem(self):
+        return self.vec[..., FMEM]
+
+    @property
+    def dur(self):
+        return self.vec[..., FDUR]
+
+    @property
+    def enq_t(self):
+        return self.vec[..., FENQ]
+
+    @property
+    def owner(self):
+        return self.vec[..., FOWNER]
+
+    @property
+    def rec_wait(self):
+        return self.vec[..., FREC]
+
+    @property
+    def res(self):
+        """[..., 2] (cores, mem) — matches the node free/cap layout."""
+        return self.vec[..., FCORES:FMEM + 1]
+
+    @staticmethod
+    def make(id=-1, cores=0, mem=0, dur=0, enq_t=0, owner=OWN, rec_wait=0) -> "JobRec":
+        parts = [id, cores, mem, dur, enq_t, owner, rec_wait]
+        return JobRec(vec=jnp.stack([jnp.asarray(p, jnp.int32) for p in parts], axis=-1))
+
+    @staticmethod
+    def invalid() -> "JobRec":
+        return JobRec(vec=_INVALID_ROW)
+
+    def with_(self, **kw) -> "JobRec":
+        vec = self.vec
+        for name, val in kw.items():
+            vec = vec.at[..., _FIDX[name]].set(jnp.asarray(val, jnp.int32))
+        return JobRec(vec=vec)
+
+
+_FIDX = {"id": FID, "cores": FCORES, "mem": FMEM, "dur": FDUR,
+         "enq_t": FENQ, "owner": FOWNER, "rec_wait": FREC}
+
 
 @struct.dataclass
 class JobQueue:
-    id: jax.Array  # [Q] int32; INVALID_ID in empty slots
-    cores: jax.Array  # [Q] int32
-    mem: jax.Array  # [Q] int32
-    dur: jax.Array  # [Q] int32 (ms)
-    enq_t: jax.Array  # [Q] int32 (ms, virtual clock)
-    owner: jax.Array  # [Q] int32 (borrower cluster index; OWN = mine)
-    rec_wait: jax.Array  # [Q] int32 (ms, last JobsMap record)
+    data: jax.Array  # [Q, NF] int32
     count: jax.Array  # [] int32
 
     @property
     def capacity(self) -> int:
-        return self.id.shape[-1]
+        return self.data.shape[-2]
+
+    # field views (each is one slice op — use sparingly in hot loops)
+    @property
+    def id(self):
+        return self.data[..., FID]
+
+    @property
+    def cores(self):
+        return self.data[..., FCORES]
+
+    @property
+    def mem(self):
+        return self.data[..., FMEM]
+
+    @property
+    def dur(self):
+        return self.data[..., FDUR]
+
+    @property
+    def enq_t(self):
+        return self.data[..., FENQ]
+
+    @property
+    def owner(self):
+        return self.data[..., FOWNER]
+
+    @property
+    def rec_wait(self):
+        return self.data[..., FREC]
 
     def slot_valid(self) -> jax.Array:
         """[Q] bool — which slots hold live jobs."""
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.count
 
 
-@struct.dataclass
-class JobRec:
-    """A single job as a pytree of scalars (one row of a JobQueue)."""
-
-    id: jax.Array
-    cores: jax.Array
-    mem: jax.Array
-    dur: jax.Array
-    enq_t: jax.Array
-    owner: jax.Array
-    rec_wait: jax.Array
-
-    @staticmethod
-    def invalid() -> "JobRec":
-        z = jnp.int32(0)
-        return JobRec(id=INVALID_ID, cores=z, mem=z, dur=z, enq_t=z, owner=OWN, rec_wait=z)
-
-
-_FIELDS = ("id", "cores", "mem", "dur", "enq_t", "owner", "rec_wait")
-
-
 def empty(capacity: int) -> JobQueue:
-    z = jnp.zeros((capacity,), jnp.int32)
-    return JobQueue(
-        id=jnp.full((capacity,), INVALID_ID, jnp.int32),
-        cores=z,
-        mem=z,
-        dur=z,
-        enq_t=z,
-        owner=jnp.full((capacity,), OWN, jnp.int32),
-        rec_wait=z,
-        count=jnp.int32(0),
-    )
+    return JobQueue(data=jnp.broadcast_to(_INVALID_ROW, (capacity, NF)).copy(),
+                    count=jnp.int32(0))
+
+
+def from_fields(id, cores, mem, dur, enq_t, owner, rec_wait, count) -> JobQueue:
+    """Build a queue from per-field [Q] arrays (one stack op)."""
+    data = jnp.stack([id, cores, mem, dur, enq_t, owner, rec_wait],
+                     axis=-1).astype(jnp.int32)
+    return JobQueue(data=data, count=jnp.asarray(count, jnp.int32))
 
 
 def get(q: JobQueue, i: Any) -> JobRec:
-    return JobRec(**{f: getattr(q, f)[i] for f in _FIELDS})
+    return JobRec(vec=q.data[i])
 
 
 def head(q: JobQueue) -> JobRec:
@@ -94,44 +161,45 @@ def push_back(q: JobQueue, job: JobRec, do: jax.Array) -> JobQueue:
     """Append one job if ``do`` (and capacity allows)."""
     ok = jnp.logical_and(do, q.count < q.capacity)
     idx = jnp.clip(q.count, 0, q.capacity - 1)
-    new = {
-        f: getattr(q, f).at[idx].set(
-            jnp.where(ok, getattr(job, f), getattr(q, f)[idx])
-        )
-        for f in _FIELDS
-    }
-    return q.replace(count=q.count + ok.astype(jnp.int32), **new)
+    data = q.data.at[idx].set(jnp.where(ok, job.vec, q.data[idx]))
+    return q.replace(data=data, count=q.count + ok.astype(jnp.int32))
 
 
-def push_many(q: JobQueue, jobs: JobQueue, take: jax.Array) -> JobQueue:
+def push_many(q: JobQueue, jobs: JobQueue, take: jax.Array,
+              prefix: bool = False) -> JobQueue:
     """Append all rows of ``jobs`` where ``take`` is set, preserving order.
 
     ``take`` is a [Qj] bool mask over ``jobs`` slots. Overflowing entries are
-    dropped (sized configs should make this impossible).
+    dropped (sized configs should make this impossible). ``prefix=True``
+    asserts the mask is a leading prefix (e.g. time-sorted arrival ingestion)
+    and skips the stable argsort — a per-tick hot path at scale.
     """
-    order = jnp.argsort(jnp.logical_not(take), stable=True)  # taken rows first
     n_take = jnp.sum(take).astype(jnp.int32)
-    dst = q.count + jnp.arange(jobs.capacity, dtype=jnp.int32)  # dst for k-th taken
+    src = jobs.data if prefix else jobs.data[jnp.argsort(jnp.logical_not(take),
+                                                         stable=True)]
+    dst = q.count + jnp.arange(jobs.capacity, dtype=jnp.int32)  # k-th taken row
     ok = jnp.logical_and(jnp.arange(jobs.capacity) < n_take, dst < q.capacity)
     dst = jnp.where(ok, dst, q.capacity)  # out-of-range writes are dropped
-    new = {}
-    for f in _FIELDS:
-        src = getattr(jobs, f)[order]
-        new[f] = getattr(q, f).at[dst].set(src, mode="drop")
+    data = q.data.at[dst].set(src, mode="drop")
     added = jnp.minimum(n_take, q.capacity - q.count)
-    return q.replace(count=q.count + added, **new)
+    return q.replace(data=data, count=q.count + added)
 
 
 def pop_front(q: JobQueue, do: jax.Array) -> JobQueue:
     """Drop the head job if ``do`` (FIFO pop), shifting everything left."""
-    inv = empty(1)
-    new = {}
-    for f in _FIELDS:
-        a = getattr(q, f)
-        shifted = jnp.roll(a, -1).at[-1].set(getattr(inv, f)[0])
-        new[f] = jnp.where(do, shifted, a)
-    n = jnp.maximum(q.count - do.astype(jnp.int32), 0)
-    return q.replace(count=n, **new)
+    shifted = jnp.roll(q.data, -1, axis=0).at[-1].set(_INVALID_ROW)
+    data = jnp.where(do, shifted, q.data)
+    return q.replace(data=data, count=jnp.maximum(q.count - do.astype(jnp.int32), 0))
+
+
+def pop_front_n(q: JobQueue, n: jax.Array) -> JobQueue:
+    """Drop the first ``n`` jobs (FIFO pop of a prefix) — one dynamic roll
+    instead of the general compact()'s argsort."""
+    n = jnp.clip(n, 0, q.count)
+    newcount = q.count - n
+    live = jnp.arange(q.capacity, dtype=jnp.int32) < newcount
+    data = jnp.where(live[:, None], jnp.roll(q.data, -n, axis=0), _INVALID_ROW)
+    return q.replace(data=data, count=newcount)
 
 
 def compact(q: JobQueue, keep: jax.Array) -> JobQueue:
@@ -141,16 +209,16 @@ def compact(q: JobQueue, keep: jax.Array) -> JobQueue:
     (scheduler.go:319,165,184). ``keep`` is evaluated on valid slots only.
     """
     keep = jnp.logical_and(keep, q.slot_valid())
-    drop = jnp.logical_not(keep)
-    order = jnp.argsort(drop, stable=True)  # kept rows first, stable
+    order = jnp.argsort(jnp.logical_not(keep), stable=True)  # kept rows first
     n_keep = jnp.sum(keep).astype(jnp.int32)
     live = jnp.arange(q.capacity, dtype=jnp.int32) < n_keep
-    inv = JobRec.invalid()
-    new = {}
-    for f in _FIELDS:
-        a = getattr(q, f)[order]
-        new[f] = jnp.where(live, a, getattr(inv, f))
-    return q.replace(count=n_keep, **new)
+    data = jnp.where(live[:, None], q.data[order], _INVALID_ROW)
+    return q.replace(data=data, count=n_keep)
+
+
+def set_col(q: JobQueue, col: int, values: jax.Array) -> JobQueue:
+    """Overwrite one field column (e.g. rec_wait) for all slots."""
+    return q.replace(data=q.data.at[..., col].set(values.astype(jnp.int32)))
 
 
 def remove_matching(q: JobQueue, job: JobRec, match_fields=("id", "cores", "mem", "dur")) -> JobQueue:
@@ -164,5 +232,5 @@ def remove_matching(q: JobQueue, job: JobRec, match_fields=("id", "cores", "mem"
     """
     m = jnp.ones((q.capacity,), bool)
     for f in match_fields:
-        m = jnp.logical_and(m, getattr(q, f) == getattr(job, f))
+        m = jnp.logical_and(m, q.data[..., _FIDX[f]] == job.vec[..., _FIDX[f]])
     return compact(q, jnp.logical_not(m))

@@ -3,10 +3,11 @@
 The reference simulates execution with one goroutine per running job:
 decrement node counters, ``time.Sleep(j.Duration)``, increment them back,
 notify the scheduler (Node.RunJob, pkg/scheduler/cluster.go:141-161). Here a
-running job is a slot in a fixed-size table carrying its end time on the
+running job is a row in one packed int32 table carrying its end time on the
 virtual clock; completion is a masked scatter-add back into the free tensor —
 no goroutines, no sleeps, and completion notification (JobFinished,
-scheduler.go:158-191) is a mask the engine consumes.
+scheduler.go:158-191) is a mask the engine consumes. Packed rows keep the
+per-tick op count low (see ops/queues.py).
 """
 
 from __future__ import annotations
@@ -15,62 +16,83 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from multi_cluster_simulator_tpu.ops.queues import INVALID_ID, OWN, JobRec
+from multi_cluster_simulator_tpu.ops.queues import JobRec
 
 NEVER = jnp.int32(2**31 - 1)
+
+# packed row layout
+RF = 8
+REND, RNODE, RCORES, RMEM, RID, ROWNER, RDUR, RENQ = range(RF)
+
+_INVALID_ROW = jnp.array([NEVER, 0, 0, 0, -1, -1, 0, 0], jnp.int32)
 
 
 @struct.dataclass
 class RunningSet:
-    end_t: jax.Array  # [S] int32 ms; NEVER when slot inactive
-    node: jax.Array  # [S] int32 node index
-    cores: jax.Array  # [S] int32
-    mem: jax.Array  # [S] int32
-    id: jax.Array  # [S] int32 job id
-    owner: jax.Array  # [S] int32 (OWN = my job; else borrower cluster)
-    dur: jax.Array  # [S] int32 (kept for the lent-return message)
-    enq_t: jax.Array  # [S] int32
+    data: jax.Array  # [S, RF] int32
     active: jax.Array  # [S] bool
 
     @property
     def capacity(self) -> int:
         return self.active.shape[-1]
 
+    @property
+    def end_t(self):
+        return self.data[..., REND]
+
+    @property
+    def node(self):
+        return self.data[..., RNODE]
+
+    @property
+    def cores(self):
+        return self.data[..., RCORES]
+
+    @property
+    def mem(self):
+        return self.data[..., RMEM]
+
+    @property
+    def id(self):
+        return self.data[..., RID]
+
+    @property
+    def owner(self):
+        return self.data[..., ROWNER]
+
+    @property
+    def dur(self):
+        return self.data[..., RDUR]
+
+    @property
+    def enq_t(self):
+        return self.data[..., RENQ]
+
 
 def empty(capacity: int) -> RunningSet:
-    z = jnp.zeros((capacity,), jnp.int32)
     return RunningSet(
-        end_t=jnp.full((capacity,), NEVER, jnp.int32),
-        node=z,
-        cores=z,
-        mem=z,
-        id=jnp.full((capacity,), INVALID_ID, jnp.int32),
-        owner=jnp.full((capacity,), OWN, jnp.int32),
-        dur=z,
-        enq_t=z,
-        active=jnp.zeros((capacity,), bool),
-    )
+        data=jnp.broadcast_to(_INVALID_ROW, (capacity, RF)).copy(),
+        active=jnp.zeros((capacity,), bool))
+
+
+def make_row(end_t, node, cores, mem, id, owner, dur, enq_t) -> jax.Array:
+    parts = [end_t, node, cores, mem, id, owner, dur, enq_t]
+    return jnp.stack([jnp.asarray(p, jnp.int32) for p in parts], axis=-1)
+
+
+def row_from_job(job: JobRec, node, t) -> jax.Array:
+    return make_row(t + job.dur, node, job.cores, job.mem, job.id, job.owner,
+                    job.dur, job.enq_t)
 
 
 def start(rs: RunningSet, job: JobRec, node: jax.Array, t: jax.Array, do: jax.Array) -> RunningSet:
     """Occupy the first free slot with a newly placed job (end = t + dur)."""
     slot = jnp.argmin(rs.active).astype(jnp.int32)  # first inactive slot
     ok = jnp.logical_and(do, jnp.logical_not(rs.active[slot]))
-
-    def w(a, v):
-        return a.at[slot].set(jnp.where(ok, v, a[slot]))
-
-    return RunningSet(
-        end_t=w(rs.end_t, t + job.dur),
-        node=w(rs.node, node),
-        cores=w(rs.cores, job.cores),
-        mem=w(rs.mem, job.mem),
-        id=w(rs.id, job.id),
-        owner=w(rs.owner, job.owner),
-        dur=w(rs.dur, job.dur),
-        enq_t=w(rs.enq_t, job.enq_t),
-        active=w(rs.active, ok),
-    )
+    row = row_from_job(job, node, t)
+    data = rs.data.at[slot].set(jnp.where(ok, row, rs.data[slot]))
+    active = rs.active.at[slot].set(jnp.where(ok, True, rs.active[slot]))
+    return RunningSet(data=data, active=active)
 
 
 def release(rs: RunningSet, free: jax.Array, t: jax.Array):
@@ -83,13 +105,9 @@ def release(rs: RunningSet, free: jax.Array, t: jax.Array):
     done = jnp.logical_and(rs.active, rs.end_t <= t)
     n_nodes = free.shape[0]
     node_idx = jnp.clip(rs.node, 0, n_nodes - 1)
-    dc = jax.ops.segment_sum(jnp.where(done, rs.cores, 0), node_idx, num_segments=n_nodes)
-    dm = jax.ops.segment_sum(jnp.where(done, rs.mem, 0), node_idx, num_segments=n_nodes)
-    free = free.at[:, 0].add(dc).at[:, 1].add(dm)
-    rs = rs.replace(
-        end_t=jnp.where(done, NEVER, rs.end_t),
-        id=jnp.where(done, INVALID_ID, rs.id),
-        owner=jnp.where(done, OWN, rs.owner),
-        active=jnp.logical_and(rs.active, jnp.logical_not(done)),
-    )
+    back = jnp.where(done[:, None], rs.data[:, RCORES:RMEM + 1], 0)
+    free = free.at[node_idx].add(back)
+    rs = RunningSet(
+        data=jnp.where(done[:, None], _INVALID_ROW, rs.data),
+        active=jnp.logical_and(rs.active, jnp.logical_not(done)))
     return rs, free, done

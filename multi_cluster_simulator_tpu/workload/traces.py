@@ -1,0 +1,72 @@
+"""Bulk / trace-style workload synthesis for the scale harness.
+
+The reference's only workload is the live Poisson client (generator.py).
+The BASELINE.json scale configs need two more shapes, generated vectorized
+(one numpy call per field, no per-cluster Python loops):
+
+- ``uniform_stream`` — N jobs per cluster with sorted-uniform arrival times:
+  the load shape used by the throughput benchmarks.
+- ``borg_like_stream`` — a Google-Borg-2019-shaped synthetic trace: machine
+  counts per job drawn heavy-tailed (lognormal), memory correlated with
+  cores, lognormal durations, and a diurnal (sinusoidal) arrival intensity.
+  Real Borg trace CSVs can be replayed through ``from_arrays``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from multi_cluster_simulator_tpu.core.state import Arrivals
+
+
+def _pack(t, cores, mem, dur):
+    C, A = t.shape
+    order = np.argsort(t, axis=1, kind="stable")
+    g = lambda a: np.take_along_axis(a, order, axis=1).astype(np.int32)
+    return Arrivals(
+        t=g(t), id=np.broadcast_to(np.arange(A, dtype=np.int32), (C, A)).copy(),
+        cores=g(cores), mem=g(mem), dur=g(dur),
+        n=np.full((C,), A, np.int32))
+
+
+def uniform_stream(n_clusters: int, jobs_per_cluster: int, horizon_ms: int,
+                   max_cores: int, max_mem: int, max_dur_ms: int,
+                   seed: int = 0, beta: float = 2.0) -> Arrivals:
+    """Sorted-uniform arrivals; Beta(b,b) sizes (the reference's job-size
+    family, client.go:87-99); uniform durations."""
+    rng = np.random.Generator(np.random.PCG64(seed))
+    C, A = n_clusters, jobs_per_cluster
+    t = rng.integers(0, horizon_ms, (C, A))
+    cores = np.floor(rng.beta(beta, beta, (C, A)) * max_cores)
+    mem = np.floor(rng.beta(beta, beta, (C, A)) * max_mem)
+    dur = rng.integers(0, max_dur_ms, (C, A))
+    return _pack(t, cores, mem, dur)
+
+
+def borg_like_stream(n_clusters: int, jobs_per_cluster: int, horizon_ms: int,
+                     max_cores: int, max_mem: int, seed: int = 0) -> Arrivals:
+    """Borg-2019-shaped synthetic trace (heavy tails + diurnal arrivals)."""
+    rng = np.random.Generator(np.random.PCG64(seed))
+    C, A = n_clusters, jobs_per_cluster
+    # diurnal arrival times by inverse-CDF of 1 + 0.6*sin(2*pi*t/day)
+    u = rng.random((C, A))
+    grid = np.linspace(0.0, 1.0, 1025)
+    day_ms = 86_400_000.0
+    intens = 1.0 + 0.6 * np.sin(2 * np.pi * grid * horizon_ms / day_ms)
+    cdf = np.cumsum(intens)
+    cdf = (cdf - cdf[0]) / (cdf[-1] - cdf[0])
+    t = np.interp(u, cdf, grid) * horizon_ms
+    # heavy-tailed sizes: lognormal cores clipped to node size
+    cores = np.clip(np.round(np.exp(rng.normal(0.4, 1.0, (C, A)))), 1, max_cores)
+    mem_frac = np.clip(rng.normal(0.6, 0.35, (C, A)), 0.05, 2.0)
+    mem = np.clip(np.round(cores / max_cores * max_mem * mem_frac), 1, max_mem)
+    # lognormal durations, median ~90 s, clipped to 1 h
+    dur = np.clip(np.exp(rng.normal(np.log(90_000.0), 1.2, (C, A))), 1_000, 3_600_000)
+    return _pack(t, cores, mem, dur)
+
+
+def from_arrays(t_ms, cores, mem, dur_ms) -> Arrivals:
+    """Replay an externally loaded trace (e.g. parsed Borg CSV) — inputs are
+    [C, A] arrays; times need not be sorted."""
+    return _pack(np.asarray(t_ms), np.asarray(cores), np.asarray(mem),
+                 np.asarray(dur_ms))

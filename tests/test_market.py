@@ -25,10 +25,8 @@ from tests.conftest import make_arrivals
 def fill_queue(jobs):
     q = Q.empty(16)
     for (c, m, d) in jobs:
-        q = Q.push_back(q, Q.JobRec(id=jnp.int32(0), cores=jnp.int32(c),
-                                    mem=jnp.int32(m), dur=jnp.int32(d),
-                                    enq_t=jnp.int32(0), owner=jnp.int32(-1),
-                                    rec_wait=jnp.int32(0)), jnp.bool_(True))
+        q = Q.push_back(q, Q.JobRec.make(id=0, cores=c, mem=m, dur=d),
+                        jnp.bool_(True))
     return q
 
 

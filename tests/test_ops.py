@@ -9,9 +9,8 @@ from multi_cluster_simulator_tpu.ops import runset as R
 
 
 def job(i=1, cores=2, mem=100, dur=5000, enq=0, owner=-1):
-    return Q.JobRec(id=jnp.int32(i), cores=jnp.int32(cores), mem=jnp.int32(mem),
-                    dur=jnp.int32(dur), enq_t=jnp.int32(enq),
-                    owner=jnp.int32(owner), rec_wait=jnp.int32(0))
+    return Q.JobRec.make(id=i, cores=cores, mem=mem, dur=dur, enq_t=enq,
+                         owner=owner)
 
 
 class TestQueues:
