@@ -43,6 +43,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from flax import struct
 
 from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
 from multi_cluster_simulator_tpu.core import state as st
@@ -58,6 +59,22 @@ _STATE_AXES = SimState(
     wait_total=0, wait_jobs=0, jobs_in_queue=0, placed_total=0, trader=0, trace=0,
 )
 _ARR_AXES = Arrivals(t=0, id=0, cores=0, mem=0, dur=0, n=0)
+
+
+@struct.dataclass
+class TickIO:
+    """Per-tick host-visible events — what a live service host must act on
+    over the network instead of in-batch (services/scheduler_host.py).
+
+    ``borrow_want``/``borrow_job`` are the failing wait-head *before* any
+    in-batch borrow matching (the BorrowResources call site,
+    scheduler.go:234); ``ret_rows``/``ret_valid`` are the finished
+    foreign-job return messages (ReturnToBorrower, server.go:260-290)."""
+
+    borrow_want: jax.Array  # [C] bool
+    borrow_job: jax.Array  # [C, Q.NF] i32
+    ret_rows: jax.Array  # [C, max_msgs, R.RF] i32
+    ret_valid: jax.Array  # [C, max_msgs] bool
 
 
 def _trace_append(tr: Trace, do, t, job_id, node, src):
@@ -127,23 +144,30 @@ def _expire_vnodes_local(s: SimState, t):
     )
 
 
-def _deliver_returns(state: SimState, run, done, cfg: SimConfig, ex) -> SimState:
+def _pack_returns(run, done, M: int):
+    """First M finished-foreign-job slots per cluster as packed rows.
+
+    ``run`` is the running set *before* release cleared the completed slots.
+    Returns (rows [C, M, RF], take [C, M]): the outbound JobFinished ->
+    ReturnToBorrower messages (scheduler.go:158-191). owner >= 0 is a
+    borrower index; FOREIGN (-2) trader placeholders are returned to nobody
+    (Go posts to the literal URL "Foreign" and gives up)."""
+    is_ret = jnp.logical_and(done, run.owner >= 0)  # [C_loc, S]
+    order = jnp.argsort(jnp.logical_not(is_ret), axis=1, stable=True)[:, :M]
+    take = jnp.take_along_axis(is_ret, order, axis=1)  # [C_loc, M]
+    rows = jnp.take_along_axis(run.data, order[..., None], axis=1)  # [C_loc, M, RF]
+    return rows, take
+
+
+def _deliver_returns(state: SimState, rows, take, ex) -> SimState:
     """Cross-cluster half of JobFinished: finished foreign jobs (owner >= 0)
     are posted back to their borrower, which removes them from its
     BorrowedQueue (server.go:115-137, 260-290). Global (non-vmapped) phase;
     under sharding the message block rides one all-gather.
 
-    ``run`` is the running set *before* release cleared the completed slots.
+    ``rows``/``take`` come from ``_pack_returns``.
     """
-    C_loc, S = done.shape
-    M = cfg.max_msgs
-    # owner >= 0 is a borrower cluster; FOREIGN (-2) trader placeholders are
-    # returned to nobody (Go posts to the literal URL "Foreign" and gives up)
-    is_ret = jnp.logical_and(done, run.owner >= 0)  # [C_loc, S]
-    # first M returning slots per cluster, as packed rows
-    order = jnp.argsort(jnp.logical_not(is_ret), axis=1, stable=True)[:, :M]
-    take = jnp.take_along_axis(is_ret, order, axis=1)  # [C_loc, M]
-    rows = jnp.take_along_axis(run.data, order[..., None], axis=1)  # [C_loc, M, RF]
+    C_loc, M = take.shape
     # dst = global borrower index; -1 marks an empty message slot
     dst_local = jnp.where(take, rows[..., R.ROWNER], -1)
     msg_dst = ex.gather(dst_local).reshape(-1)  # [C_tot*M]
@@ -441,6 +465,10 @@ class Engine:
 
     # -- single tick (pure; vmap/global composition) --
     def tick(self, state: SimState, arrivals: Arrivals) -> SimState:
+        return self.tick_io(state, arrivals)[0]
+
+    def tick_io(self, state: SimState, arrivals: Arrivals) -> tuple[SimState, TickIO]:
+        """One tick, also returning the host-visible TickIO events."""
         cfg = self.cfg
         t = state.t + cfg.tick_ms
 
@@ -449,8 +477,9 @@ class Engine:
         st2, done = jax.vmap(_release_local, in_axes=(_STATE_AXES, None),
                              out_axes=(_STATE_AXES, 0))(state, t)
         state = st2
+        ret_rows, ret_valid = _pack_returns(run_before, done, cfg.max_msgs)
         if cfg.borrowing:
-            state = _deliver_returns(state, run_before, done, cfg, self.ex)
+            state = _deliver_returns(state, ret_rows, ret_valid, self.ex)
 
         # 2. virtual-node expiry (off in parity mode — reference keeps them)
         if cfg.trader.enabled and cfg.trader.expire_virtual_nodes:
@@ -464,6 +493,9 @@ class Engine:
                          out_axes=_STATE_AXES)(state, arrivals, t)
 
         # 4. scheduling pass
+        C = state.arr_ptr.shape[0]
+        want = jnp.zeros((C,), bool)
+        bjob_vec = jnp.zeros((C, Q.NF), jnp.int32)
         if cfg.policy == PolicyKind.DELAY:
             state = jax.vmap(functools.partial(_delay_local, cfg=cfg),
                              in_axes=(_STATE_AXES, None), out_axes=_STATE_AXES)(state, t)
@@ -475,6 +507,7 @@ class Engine:
                 functools.partial(_fifo_local, cfg=cfg),
                 in_axes=(_STATE_AXES, None),
                 out_axes=(_STATE_AXES, 0, 0))(state, t)
+            bjob_vec = bjobs.vec
             # 5. borrow matching
             if cfg.borrowing:
                 state = _borrow_match(state, want, bjobs, cfg, self.ex)
@@ -488,7 +521,9 @@ class Engine:
         if self._trade_round is not None:
             state = self._trade_round(state, t)
 
-        return state.replace(t=t)
+        io = TickIO(borrow_want=want, borrow_job=bjob_vec,
+                    ret_rows=ret_rows, ret_valid=ret_valid)
+        return state.replace(t=t), io
 
     # -- scan driver --
     def run(self, state: SimState, arrivals: Arrivals, n_ticks: int) -> SimState:

@@ -1,0 +1,29 @@
+"""The service shell — the reference's microservice constellation, rebuilt.
+
+The reference deploys as five independently-launched OS processes wired over
+HTTP/JSON and gRPC (SURVEY.md §1): a registry (service discovery + heartbeat,
+pkg/registry), N schedulers (pkg/scheduler), N traders (pkg/trader), workload
+clients (pkg/client), and a log sink (log/). This package preserves that
+topology and its wire surface — the same HTTP endpoints, the same proto
+messages — while the *decisions* inside each scheduler/trader host run as
+jitted kernels on the accelerator (the north-star architecture: hosts keep
+the service fabric, placement moves to the device).
+
+Modules:
+  httpd      — routed threading HTTP server + client helpers (net/http analogue)
+  telemetry  — structured logging, spans, metrics (internal/service/telemetry.go)
+  registry   — discovery server + client cache + heartbeat (pkg/registry)
+  logsink    — centralized log service (log/)
+  lifecycle  — service bootstrap/shutdown (internal/service/service.go)
+  host_ops   — jitted device-boundary ops the live hosts call between ticks
+  scheduler_host — the scheduler service (pkg/scheduler servers)
+  trader_host    — the trader service (pkg/trader)
+  workload       — the workload-generator client service (pkg/client)
+  rpc        — gRPC bindings over the proto messages (pkg/trader/gen)
+  main       — entry points (cmd/*)
+"""
+
+from multi_cluster_simulator_tpu.services.registry import (  # noqa: F401
+    RegistryServer, ServiceRegistration, SERVICE_SCHEDULER, SERVICE_TRADER,
+    SERVICE_LOG,
+)

@@ -1,0 +1,148 @@
+"""Jitted device-boundary ops for the live service hosts.
+
+A live scheduler host owns a C=1 ``SimState`` on the device and advances it
+with ``Engine.tick_io``. Between ticks, HTTP/gRPC handlers must mutate that
+state the way the reference's handlers mutate the Go scheduler's queues —
+append to LentQueue on ``/borrow`` (pkg/scheduler/server.go:94-107), remove
+from BorrowedQueue on ``/lent`` (server.go:115-137), carve lender capacity on
+``ProvideVirtualNode`` (cluster.go:87-125), attach a virtual node on
+``ReceiveVirtualNode`` (cluster.go:65-85). Each such mutation is one small
+jitted pure function here: host threads hold a lock, call the op, and swap
+the state pointer. This is the "host keeps the service surface, the device
+keeps the state" boundary of the north-star design.
+
+All ops take and return the full batched (C=1) SimState so the same state
+object flows between the tick loop and the handlers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from multi_cluster_simulator_tpu.core.spec import CORES, MEM
+from multi_cluster_simulator_tpu.core.state import SimState
+from multi_cluster_simulator_tpu.market.trader import FOREIGN, PLACEHOLDER_ID
+from multi_cluster_simulator_tpu.ops import carve as carve_ops
+from multi_cluster_simulator_tpu.ops import placement as P
+from multi_cluster_simulator_tpu.ops import queues as Q
+from multi_cluster_simulator_tpu.ops import runset as R
+
+
+def _c0(tree):
+    """View of cluster 0 (the live host's only cluster)."""
+    return jax.tree.map(lambda a: a[0], tree)
+
+
+def _put0(tree, sub):
+    return jax.tree.map(lambda a, b: a.at[0].set(b), tree, sub)
+
+
+@jax.jit
+def lend_feasible(state: SimState, cores, mem) -> jax.Array:
+    """The /borrow handler's Lend() probe (scheduler.go:194-202): any node
+    with strictly more free cores AND memory."""
+    job = Q.JobRec.make(cores=cores, mem=mem)
+    return P.can_lend(state.node_free[0], state.node_active[0], job)
+
+
+@jax.jit
+def push_lent(state: SimState, job_vec) -> SimState:
+    """Append a foreign job to the LentQueue (server.go:94-107). The host
+    sets the row's owner field to its borrower-table index beforehand."""
+    lent0 = Q.push_back(_c0(state.lent), Q.JobRec(vec=job_vec),
+                        jnp.ones((), bool))
+    return state.replace(lent=_put0(state.lent, lent0))
+
+
+@jax.jit
+def remove_borrowed(state: SimState, job_vec) -> SimState:
+    """The /lent handler (server.go:115-137): a returned finished job is
+    removed from the BorrowedQueue by field equality."""
+    b0 = Q.remove_matching(_c0(state.borrowed), Q.JobRec(vec=job_vec))
+    return state.replace(borrowed=_put0(state.borrowed, b0))
+
+
+@jax.jit
+def commit_borrow(state: SimState, job_vec) -> SimState:
+    """Borrower side of a successful /borrow round (scheduler.go:239-242):
+    pop the wait head (gated on it still being the same job) and append it
+    to the BorrowedQueue."""
+    job = Q.JobRec(vec=job_vec)
+    wait0 = _c0(state.wait)
+    do = jnp.logical_and(wait0.count > 0, Q.head(wait0).id == job.id)
+    wait0 = Q.pop_front(wait0, do)
+    b0 = Q.push_back(_c0(state.borrowed), job, do)
+    return state.replace(wait=_put0(state.wait, wait0),
+                         borrowed=_put0(state.borrowed, b0))
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def carve_occupy(state: SimState, cores, mem, dur_ms,
+                 mode: str = "asbuilt") -> tuple[SimState, jax.Array]:
+    """Lender side of ApproveContract: AllocateVirtualNodeResources
+    (cluster.go:87-125) — plan per-node carve amounts, subtract them from
+    free, and occupy them as Foreign placeholder running jobs for the
+    contract duration. Returns (state', ok)."""
+    free0 = state.node_free[0]
+    amounts, ok = carve_ops.carve_plan(
+        free0, state.node_active[0], jnp.asarray(cores, jnp.int32),
+        jnp.asarray(mem, jnp.int32), mode=mode)
+    free0 = free0 - jnp.where(ok, amounts, 0)
+    t = state.t
+    dur = jnp.asarray(dur_ms, jnp.int32)
+
+    def add_placeholder(rn, n):
+        occ = jnp.logical_and(ok, jnp.logical_or(amounts[n, CORES] > 0,
+                                                 amounts[n, MEM] > 0))
+        slot = jnp.argmin(rn.active).astype(jnp.int32)
+        okk = jnp.logical_and(occ, jnp.logical_not(rn.active[slot]))
+        row = R.make_row(t + dur, n, amounts[n, CORES], amounts[n, MEM],
+                         PLACEHOLDER_ID, FOREIGN, dur, t)
+        return R.RunningSet(
+            data=rn.data.at[slot].set(jnp.where(okk, row, rn.data[slot])),
+            active=rn.active.at[slot].set(
+                jnp.where(okk, True, rn.active[slot]))), None
+
+    run0, _ = jax.lax.scan(add_placeholder, _c0(state.run),
+                           jnp.arange(free0.shape[0], dtype=jnp.int32))
+    state = state.replace(node_free=state.node_free.at[0].set(free0),
+                          run=_put0(state.run, run0))
+    return state, ok
+
+
+@functools.partial(jax.jit, static_argnames=("vstart", "expire"))
+def add_virtual_node(state: SimState, cores, mem, dur_ms, vstart: int,
+                     expire: bool = False) -> tuple[SimState, jax.Array]:
+    """Borrower side: AddVirtualNode (cluster.go:65-85) — activate the first
+    free virtual node slot with the contract's capacity. The reference never
+    removes virtual nodes; ``expire=True`` arms the engine's expiry phase
+    instead (config.trader.expire_virtual_nodes)."""
+    cap0, free0 = state.node_cap[0], state.node_free[0]
+    act0, exp0 = state.node_active[0], state.node_expire[0]
+    is_v = jnp.arange(cap0.shape[0]) >= vstart
+    slot_free = jnp.logical_and(is_v, jnp.logical_not(act0))
+    slot = jnp.argmax(slot_free).astype(jnp.int32)
+    ok = jnp.any(slot_free)
+    newcap = jnp.stack([jnp.asarray(cores, jnp.int32),
+                        jnp.asarray(mem, jnp.int32)])
+    cap0 = cap0.at[slot].set(jnp.where(ok, newcap, cap0[slot]))
+    free0 = free0.at[slot].set(jnp.where(ok, newcap, free0[slot]))
+    act0 = act0.at[slot].set(jnp.where(ok, True, act0[slot]))
+    exp_val = (state.t + jnp.asarray(dur_ms, jnp.int32)) if expire else R.NEVER
+    exp0 = exp0.at[slot].set(jnp.where(ok, exp_val, exp0[slot]))
+    return state.replace(
+        node_cap=state.node_cap.at[0].set(cap0),
+        node_free=state.node_free.at[0].set(free0),
+        node_active=state.node_active.at[0].set(act0),
+        node_expire=state.node_expire.at[0].set(exp0)), ok
+
+
+@jax.jit
+def rebase_arrivals(state: SimState, shift) -> SimState:
+    """Shift the arrival cursor left by ``shift`` — the host compacted its
+    arrival ring by dropping ``shift`` consumed entries from the front."""
+    return state.replace(arr_ptr=jnp.maximum(
+        state.arr_ptr - jnp.asarray(shift, jnp.int32), 0))

@@ -1,0 +1,138 @@
+"""Routed threading HTTP server + client helpers.
+
+The reference builds every control-plane surface on Go's stdlib ``net/http``
+(mux handlers registered per path, e.g. pkg/scheduler/server.go:22-153,
+pkg/registry/server.go:180-217). This is the Python-stdlib equivalent: one
+``ThreadingHTTPServer`` per service with a route table, plus tiny urllib
+client helpers for the JSON POST / GET idioms the services use between each
+other (http.Post with a JSON body, server.go:207; http.Get heartbeats,
+registry/server.go:141).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+# A handler takes (body_bytes, headers_dict) and returns
+# (status_code, body_bytes_or_None). Content type is JSON unless overridden.
+Route = Callable[[bytes, dict], tuple[int, Optional[bytes]]]
+
+
+class RoutedHTTPServer:
+    """An HTTP server with a (method, path) route table.
+
+    ``port=0`` binds an ephemeral port (the reference picks random ports in
+    [1025, 49151), cmd/scheduler/main.go:62-63 — the OS-assigned ephemeral
+    port is the same capability without the collision risk).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, logger=None):
+        self.routes: dict[tuple[str, str], Route] = {}
+        self.logger = logger
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _dispatch(self, method: str):
+                path = self.path.split("?", 1)[0]
+                fn = outer.routes.get((method, path))
+                if fn is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                try:
+                    status, out = fn(body, dict(self.headers))
+                except Exception as e:  # route bug -> 500, keep serving
+                    if outer.logger is not None:
+                        outer.logger.error("handler %s %s failed: %r",
+                                           method, path, e)
+                    status, out = 500, repr(e).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out or b"")))
+                self.end_headers()
+                if out:
+                    self.wfile.write(out)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+            def log_message(self, fmt, *args):  # quiet; services log themselves
+                if outer.logger is not None:
+                    outer.logger.debug("http: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self.host = host
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+
+    def route(self, method: str, path: str, fn: Route) -> None:
+        self.routes[(method, path)] = fn
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name=f"httpd:{self.port}", daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# client helpers
+# ---------------------------------------------------------------------------
+
+def post_json(url: str, obj, timeout: float = 5.0) -> tuple[int, bytes]:
+    """http.Post(url, "application/json", body) — returns (status, body).
+    Transport errors surface as status 0."""
+    data = json.dumps(obj).encode()
+    req = urllib.request.Request(url, data=data, method="POST",
+                                 headers={"Content-Type": "application/json"})
+    return _do(req, timeout)
+
+
+def post_bytes(url: str, data: bytes, content_type: str = "text/plain",
+               timeout: float = 5.0) -> tuple[int, bytes]:
+    req = urllib.request.Request(url, data=data, method="POST",
+                                 headers={"Content-Type": content_type})
+    return _do(req, timeout)
+
+
+def get(url: str, timeout: float = 5.0) -> tuple[int, bytes]:
+    return _do(urllib.request.Request(url, method="GET"), timeout)
+
+
+def delete(url: str, data: bytes = b"", timeout: float = 5.0) -> tuple[int, bytes]:
+    req = urllib.request.Request(url, data=data, method="DELETE",
+                                 headers={"Content-Type": "text/plain"})
+    return _do(req, timeout)
+
+
+def _do(req, timeout: float) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as res:
+            return res.status, res.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+    except (urllib.error.URLError, OSError, TimeoutError):
+        return 0, b""

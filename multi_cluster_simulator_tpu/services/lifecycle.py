@@ -1,0 +1,95 @@
+"""Service bootstrap + shutdown — the internal/service equivalent.
+
+Reference: internal/service/service.go:13-63 — every ``cmd/`` main calls
+``service.Start(ctx, host, port, registration, registerHandlers)`` which
+(1) registers the HTTP handlers, (2) starts the server, (3) registers with
+the registry; shutdown deregisters and stops the server. The telemetry
+factories (telemetry.go:43-143) hang off the same bootstrap. ``Service``
+bundles exactly that: httpd + logger + tracer + meter + registry client with
+one ``start()`` / ``shutdown()`` pair. Subclasses add routes in
+``register_handlers`` and extra threads via ``on_start``/``on_shutdown``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from multi_cluster_simulator_tpu.services import httpd, telemetry
+from multi_cluster_simulator_tpu.services.registry import RegistryClient
+
+
+class Service:
+    """One microservice process: HTTP surface + telemetry + registration."""
+
+    service_name: str = "Service"
+    required_services: list = []
+
+    def __init__(self, name: str, registry_url: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0, speed: float = 1.0,
+                 log_mode: str = "development",
+                 metrics_path: Optional[str] = None,
+                 spans_path: Optional[str] = None):
+        self.name = name
+        self.speed = speed
+        self.logger = telemetry.create_logger(name, mode=log_mode)
+        self.tracer = telemetry.Tracer(name, path=spans_path)
+        self.meter = telemetry.Meter(name, export_path=metrics_path,
+                                     export_period_s=5.0 / speed)
+        self.httpd = httpd.RoutedHTTPServer(host, port, logger=self.logger)
+        self.url = self.httpd.url
+        # What gets registered as ServiceURL. Defaults to the HTTP server;
+        # the trader advertises its gRPC address instead (the reference
+        # registers the trader's gRPC addr, cmd/trader/main.go:62-75).
+        self.advertised_url = self.url
+        self.registry: Optional[RegistryClient] = None
+        if registry_url is not None:
+            self.registry = RegistryClient(self.httpd, registry_url,
+                                           logger=self.logger,
+                                           on_update=self.on_providers_update)
+        self._started = False
+
+    # -- subclass hooks --
+    def register_handlers(self) -> None:
+        """Install routes on self.httpd (RegisterHandlers analogue)."""
+
+    def on_start(self) -> None:
+        """Start background loops (tick threads, monitors)."""
+
+    def on_shutdown(self) -> None:
+        """Stop background loops."""
+
+    def on_providers_update(self, patch: dict) -> None:
+        """Called when the registry pushes a provider patch."""
+
+    # -- lifecycle (service.go:13-33) --
+    def start(self) -> None:
+        if self._started:
+            return
+        self.register_handlers()
+        self.httpd.start()
+        self.meter.start_exporter()
+        self.on_start()  # may set advertised_url (gRPC services)
+        if self.registry is not None:
+            self.registry.register(self.service_name, self.advertised_url,
+                                   self.required_services)
+        self._started = True
+        self.logger.info("%s started at %s", self.service_name, self.url)
+
+    def shutdown(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        self.on_shutdown()
+        if self.registry is not None:
+            self.registry.shutdown()
+        self.meter.stop_exporter()
+        self.httpd.shutdown()
+        self.logger.info("%s at %s stopped", self.service_name, self.url)
+
+    # -- context manager sugar for tests --
+    def __enter__(self) -> "Service":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

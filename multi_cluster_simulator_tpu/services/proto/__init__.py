@@ -1,0 +1,1 @@
+"""Generated protobuf message modules (see generate.sh)."""

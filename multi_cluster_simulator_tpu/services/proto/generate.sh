@@ -1,0 +1,10 @@
+#!/bin/sh
+# Regenerate *_pb2.py from the proto schemas (the reference's
+# pkg/trader/proto/protoc.sh analogue). grpc_tools is not available in this
+# image, so only message classes are generated; the service method tables
+# live in services/rpc.py over grpcio's generic handlers.
+set -e
+cd "$(dirname "$0")"
+protoc --python_out=. trader.proto resource_channel.proto
+# package-qualify the cross-file import for package-relative loading
+sed -i 's/^import trader_pb2 as trader__pb2$/from multi_cluster_simulator_tpu.services.proto import trader_pb2 as trader__pb2/' resource_channel_pb2.py

@@ -1,0 +1,400 @@
+"""The scheduler service: a live host process around the device engine.
+
+Reference: pkg/scheduler's HTTP surface + run loop (server.go:22-153,
+scheduler.go:101-124) and cmd/scheduler/main.go wiring. One service hosts one
+cluster. The Go scheduler's 1 s loop *is* its decision engine; here the loop
+body is one jitted ``Engine.tick_io`` call on a C=1 ``SimState`` — the
+placement kernels, queue bookkeeping, and wait accounting all run on the
+device, and the host acts on the returned ``TickIO`` over the network:
+borrow fan-out (BorrowResources, server.go:160-248) and finished-foreign-job
+returns (ReturnToBorrower, server.go:260-290).
+
+Wire parity: the HTTP endpoints (``/``, ``/delay``, ``/borrow``, ``/lent``,
+``/newClient``) accept and emit the reference's Go-struct JSON shapes —
+``Job`` fields ``Id/CoresNeeded/MemoryNeeded/Duration`` (int64 nanoseconds,
+Go ``time.Duration``) ``/Ownership``; ``/newClient`` returns the Go
+``Cluster`` JSON (spec.to_json). A Go client of the reference could talk to
+this service unchanged.
+
+``speed`` scales virtual time against wall time: the reference's 1 s tick
+becomes ``tick_ms / 1000 / speed`` wall seconds (speed=1000 → ~1 ms/tick,
+used by the integration tests).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from typing import Optional
+
+import jax
+import numpy as np
+
+from multi_cluster_simulator_tpu.config import RETURN_ATTEMPTS, SimConfig
+from multi_cluster_simulator_tpu.core import state as st
+from multi_cluster_simulator_tpu.core.engine import Engine
+from multi_cluster_simulator_tpu.core.spec import ClusterSpec
+from multi_cluster_simulator_tpu.core.state import Arrivals, init_state
+from multi_cluster_simulator_tpu.ops import queues as Q
+from multi_cluster_simulator_tpu.ops import runset as R
+from multi_cluster_simulator_tpu.services import host_ops, httpd
+from multi_cluster_simulator_tpu.services.lifecycle import Service
+from multi_cluster_simulator_tpu.services.registry import SERVICE_SCHEDULER
+
+
+# -- Go Job JSON wire format (scheduler.go:65-73; Duration is nanoseconds) --
+
+def job_to_json(id, cores, mem, dur_ms, ownership="") -> dict:
+    return {"Id": int(id), "CoresNeeded": int(cores),
+            "MemoryNeeded": int(mem), "State": 0,
+            "Duration": int(dur_ms) * 1_000_000, "Ownership": ownership}
+
+
+def job_from_json(d: dict) -> tuple[int, int, int, int, str]:
+    """(id, cores, mem, dur_ms, ownership); accepts Go field names."""
+    dur_ns = int(d.get("Duration", 0))
+    return (int(d.get("Id", 0)), int(d.get("CoresNeeded", 0)),
+            int(d.get("MemoryNeeded", 0)), dur_ns // 1_000_000,
+            str(d.get("Ownership", "") or ""))
+
+
+class SchedulerService(Service):
+    service_name = SERVICE_SCHEDULER
+    # discovers *peer* schedulers for borrowing (cmd/scheduler/main.go:81-86)
+    required_services = [SERVICE_SCHEDULER]
+
+    def __init__(self, name: str, spec: ClusterSpec, cfg: SimConfig,
+                 registry_url: Optional[str] = None, speed: float = 1.0,
+                 grpc_port: Optional[int] = 0, **kw):
+        super().__init__(name, registry_url=registry_url, speed=speed, **kw)
+        # gRPC ResourceChannel for this cluster's trader; None disables it
+        # (cmd/scheduler starts one alongside the HTTP server, main.go:62-79)
+        self.grpc_port = grpc_port
+        self.grpc_addr: Optional[str] = None
+        self._grpc_server = None
+        self.spec = spec
+        self.cfg = cfg
+        self.engine = Engine(cfg)
+        self._tick_fn = jax.jit(self.engine.tick_io)
+        self._slock = threading.RLock()  # guards state + arrival buffer
+        self.state = init_state(cfg, [spec])
+        # host-side arrival staging ring ([1, A] to match the engine shapes)
+        A = cfg.max_arrivals
+        self._arr = {k: np.zeros((1, A), np.int32)
+                     for k in ("t", "id", "cores", "mem", "dur")}
+        self._arr_n = 0
+        # submit handlers append here without touching the device lock;
+        # the tick thread drains it (so an in-flight compile or device step
+        # never blocks the HTTP surface)
+        self._pending: list[tuple] = []
+        self._plock = threading.Lock()
+        # borrower table: Ownership URL <-> owner index (>=1; 0 is this
+        # cluster's own index in batch-engine semantics)
+        self._owner_urls: list[str] = ["<self>"]
+        self._owner_idx: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._tick_thread: Optional[threading.Thread] = None
+        self._pool = ThreadPoolExecutor(max_workers=8,
+                                        thread_name_prefix=f"{name}-io")
+        self.ticks_run = 0
+
+    # ------------------------------------------------------------------
+    # HTTP surface (RegisterHandlers, server.go:22-153)
+    # ------------------------------------------------------------------
+    def register_handlers(self) -> None:
+        self.httpd.route("POST", "/", self._handle_submit_fifo)
+        self.httpd.route("POST", "/delay", self._handle_submit_delay)
+        self.httpd.route("POST", "/borrow", self._handle_borrow)
+        self.httpd.route("POST", "/lent", self._handle_lent)
+        self.httpd.route("GET", "/newClient", self._handle_new_client)
+        self.httpd.route("GET", "/metrics",
+                         lambda b, h: (200, self.meter.render_prometheus().encode()))
+
+    def _handle_submit_fifo(self, body: bytes, headers: dict):
+        """POST / — FIFO-path submit to the ReadyQueue (server.go:23-51);
+        echoes a GET <Referer>/jobAdded acknowledgement."""
+        try:
+            job = job_from_json(json.loads(body))
+        except ValueError:
+            return 400, None
+        self._stage_arrival(job)
+        referer = headers.get("Referer")
+        if referer:
+            self._pool.submit(httpd.get, referer.rstrip("/") + "/jobAdded")
+        return 200, None
+
+    def _handle_submit_delay(self, body: bytes, headers: dict):
+        """POST /delay — DELAY-path submit to Level0 + wait-timer start
+        (server.go:53-78). The device ingest phase starts the wait timer
+        and the on-state jobs_in_queue counter; the meter here mirrors the
+        handler-side OTel counter (server.go:75-76)."""
+        try:
+            job = job_from_json(json.loads(body))
+        except ValueError:
+            return 400, None
+        self._stage_arrival(job)
+        self.meter.add("jobs_in_queue", 1)
+        return 200, None
+
+    def _handle_borrow(self, body: bytes, headers: dict):
+        """POST /borrow — a peer asks me to host a job: Lend() feasibility,
+        then append to the LentQueue with the borrower's ownership
+        (server.go:80-113). 406 when infeasible."""
+        try:
+            jid, cores, mem, dur_ms, ownership = job_from_json(json.loads(body))
+        except ValueError:
+            return 400, None
+        with self._slock:
+            if not bool(host_ops.lend_feasible(self.state, cores, mem)):
+                return 406, None
+            owner = self._intern_owner(ownership)
+            vec = Q.JobRec.make(id=jid, cores=cores, mem=mem, dur=dur_ms,
+                                enq_t=int(self.state.t), owner=owner).vec
+            self.state = host_ops.push_lent(self.state, vec)
+        self.logger.info("lent: accepted job %d from %s", jid, ownership)
+        return 200, None
+
+    def _handle_lent(self, body: bytes, headers: dict):
+        """POST /lent — a lender returns my finished job: remove it from the
+        BorrowedQueue by field equality (server.go:115-137)."""
+        try:
+            jid, cores, mem, dur_ms, _ = job_from_json(json.loads(body))
+        except ValueError:
+            return 400, None
+        vec = Q.JobRec.make(id=jid, cores=cores, mem=mem, dur=dur_ms).vec
+        with self._slock:
+            self.state = host_ops.remove_borrowed(self.state, vec)
+        return 200, None
+
+    def _handle_new_client(self, body: bytes, headers: dict):
+        """GET /newClient — serialize my cluster for a joining workload
+        client (server.go:139-153)."""
+        return 200, json.dumps(self.spec.to_json()).encode()
+
+    # ------------------------------------------------------------------
+    # arrival staging (the tensor form of the submit handlers)
+    # ------------------------------------------------------------------
+    def _stage_arrival(self, job) -> None:
+        jid, cores, mem, dur_ms, _ = job
+        with self._plock:
+            self._pending.append((jid, cores, mem, dur_ms))
+
+    def _drain_pending(self) -> None:
+        """Move submitted jobs into the arrival ring, timestamped at the
+        current virtual time. Caller holds the state lock."""
+        with self._plock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        now = int(np.asarray(self.state.t))
+        for jid, cores, mem, dur_ms in pending:
+            if self._arr_n == self.cfg.max_arrivals:
+                self._compact_arrivals()
+            if self._arr_n == self.cfg.max_arrivals:
+                self.logger.error("arrival ring full; dropping job %d", jid)
+                continue
+            i = self._arr_n
+            self._arr["t"][0, i] = now
+            self._arr["id"][0, i] = jid
+            self._arr["cores"][0, i] = cores
+            self._arr["mem"][0, i] = mem
+            self._arr["dur"][0, i] = dur_ms
+            self._arr_n += 1
+
+    def _compact_arrivals(self) -> None:
+        """Drop the consumed prefix of the ring and rebase the device
+        cursor (host_ops.rebase_arrivals)."""
+        consumed = int(np.asarray(self.state.arr_ptr)[0])
+        if consumed <= 0:
+            return
+        for a in self._arr.values():
+            a[0, :self._arr_n - consumed] = a[0, consumed:self._arr_n]
+        self._arr_n -= consumed
+        self.state = host_ops.rebase_arrivals(self.state, consumed)
+
+    def _arrivals_device(self) -> Arrivals:
+        return Arrivals(
+            t=self._arr["t"], id=self._arr["id"], cores=self._arr["cores"],
+            mem=self._arr["mem"], dur=self._arr["dur"],
+            n=np.array([self._arr_n], np.int32))
+
+    # ------------------------------------------------------------------
+    # tick loop (the Run goroutine, scheduler.go:101-124)
+    # ------------------------------------------------------------------
+    def on_start(self) -> None:
+        self._warmup()
+        if self.grpc_port is not None:
+            from multi_cluster_simulator_tpu.services import rpc
+            cadence_s = self.cfg.trader.state_cadence_ms / 1000.0 / self.speed
+            self._grpc_server, self.grpc_addr = rpc.start_server(
+                [rpc.resource_channel_handler(self, cadence_s, self._stop)],
+                port=self.grpc_port)
+        self._tick_thread = threading.Thread(target=self._tick_loop,
+                                             daemon=True,
+                                             name=f"{self.name}-tick")
+        self._tick_thread.start()
+
+    def on_shutdown(self) -> None:
+        self._stop.set()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=1)
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout=10)
+        self._pool.shutdown(wait=False)
+
+    def _warmup(self) -> None:
+        """Compile the tick and the handler-path host ops before serving
+        traffic, so no HTTP request ever waits on an XLA compile."""
+        import jax
+        jax.block_until_ready(
+            self._tick_fn(self.state, self._arrivals_device()))  # discarded
+        vec = Q.JobRec.make(id=0, cores=1, mem=1, dur=1).vec
+        host_ops.lend_feasible(self.state, 1, 1)
+        host_ops.push_lent(self.state, vec)
+        host_ops.remove_borrowed(self.state, vec)
+        host_ops.commit_borrow(self.state, vec)
+
+    def _tick_loop(self) -> None:
+        period = self.cfg.tick_ms / 1000.0 / self.speed
+        while not self._stop.wait(period):
+            try:
+                self._tick_once()
+            except Exception as e:  # keep the loop alive; report loudly
+                self.logger.error("tick failed: %r", e)
+
+    def _tick_once(self) -> None:
+        with self._slock:
+            self._drain_pending()
+            state, io = self._tick_fn(self.state, self._arrivals_device())
+            self.state = state
+            io = jax.tree.map(np.asarray, io)
+            t = int(np.asarray(state.t))
+        self.ticks_run += 1
+        # waitTime histogram on the reference's 5 s metric cadence
+        # (metrics.go:19-30)
+        if t % 5_000 == 0:
+            with self._slock:
+                self.meter.record("waitTime",
+                                  float(np.asarray(st.avg_wait_ms(self.state))[0]))
+        self._process_returns(io)
+        self._process_borrow(io)
+
+    # -- TickIO actions --
+    def _process_returns(self, io) -> None:
+        """POST each finished foreign job back to its borrower's /lent,
+        up to 3 attempts (ReturnToBorrower, server.go:260-290)."""
+        for m in range(io.ret_valid.shape[1]):
+            if not io.ret_valid[0, m]:
+                continue
+            row = io.ret_rows[0, m]
+            owner = int(row[R.ROWNER])
+            if not (1 <= owner < len(self._owner_urls)):
+                continue
+            url = self._owner_urls[owner]
+            payload = job_to_json(row[R.RID], row[R.RCORES], row[R.RMEM],
+                                  row[R.RDUR], ownership=url)
+            self._pool.submit(self._post_return, url, payload)
+
+    def _post_return(self, url: str, payload: dict) -> None:
+        for _ in range(RETURN_ATTEMPTS):
+            status, _ = httpd.post_json(url.rstrip("/") + "/lent", payload)
+            if status == 200:
+                return
+        self.logger.error("return to %s failed after %d attempts", url,
+                          RETURN_ATTEMPTS)
+
+    def _process_borrow(self, io) -> None:
+        """BorrowResources (server.go:160-248): broadcast the failing
+        wait-head to every peer scheduler; first 200 OK wins and the job
+        moves WaitQueue -> BorrowedQueue. Lenders that also said OK keep
+        their LentQueue copies — the reference never aborts them."""
+        if not (self.cfg.borrowing and bool(io.borrow_want[0])):
+            return
+        if self.registry is None:
+            return
+        try:
+            peers = [u for u in self.registry.get_providers(SERVICE_SCHEDULER)
+                     if u != self.url]
+        except LookupError:
+            return
+        if not peers:
+            return
+        vec = io.borrow_job[0]
+        job = Q.JobRec(vec=vec)
+        payload = job_to_json(int(job.id), int(job.cores), int(job.mem),
+                              int(job.dur), ownership=self.url)
+        futs = {self._pool.submit(httpd.post_json, p.rstrip("/") + "/borrow",
+                                  payload): p for p in peers}
+        for fut in as_completed(futs, timeout=10):
+            status, _ = fut.result()
+            if status == 200:
+                with self._slock:
+                    self.state = host_ops.commit_borrow(self.state, vec)
+                self.logger.info("borrowed: job %d hosted by %s",
+                                 int(job.id), futs[fut])
+                break
+
+    def _intern_owner(self, url: str) -> int:
+        if url not in self._owner_idx:
+            self._owner_idx[url] = len(self._owner_urls)
+            self._owner_urls.append(url)
+        return self._owner_idx[url]
+
+    # ------------------------------------------------------------------
+    # ResourceChannel surface (trader_server.go) — called by the rpc layer
+    # ------------------------------------------------------------------
+    def cluster_state(self) -> dict:
+        """One ClusterState sample (trader_server.go:24-47)."""
+        with self._slock:
+            cu, mu = st.snapshot_utilization(self.state)
+            return {
+                "cores_utilization": float(np.asarray(cu)[0]),
+                "memory_utilization": float(np.asarray(mu)[0]),
+                "total_cpu": int(np.asarray(self.state.trader.snap_total_cores)[0]),
+                "total_memory": int(np.asarray(self.state.trader.snap_total_mem)[0]),
+                "average_wait_time": float(np.asarray(st.avg_wait_ms(self.state))[0]),
+            }
+
+    def level1_jobs(self) -> list[dict]:
+        """GetLevel1 for ProvideJobs (scheduler.go:204-214)."""
+        with self._slock:
+            l1 = jax.tree.map(np.asarray, self.state.l1)
+        n = int(l1.count[0])
+        return [{"cores": int(l1.data[0, i, Q.FCORES]),
+                 "mem": int(l1.data[0, i, Q.FMEM]),
+                 "dur_ms": int(l1.data[0, i, Q.FDUR])} for i in range(n)]
+
+    def provide_virtual_node(self, cores: int, mem: int, dur_ms: int) -> bool:
+        """Lender-side carve (ProvideVirtualNode -> cluster.go:87-125)."""
+        with self._slock:
+            state, ok = host_ops.carve_occupy(
+                self.state, cores, mem, dur_ms,
+                mode=self.cfg.trader.carve_mode)
+            ok = bool(ok)
+            if ok:
+                self.state = state
+        return ok
+
+    def receive_virtual_node(self, cores: int, mem: int, dur_ms: int) -> bool:
+        """Borrower-side attach (ReceiveVirtualNode -> cluster.go:65-85)."""
+        with self._slock:
+            state, ok = host_ops.add_virtual_node(
+                self.state, cores, mem, dur_ms, vstart=self.cfg.max_nodes,
+                expire=self.cfg.trader.expire_virtual_nodes)
+            ok = bool(ok)
+            if ok:
+                self.state = state
+        return ok
+
+    # -- introspection for tests/operators --
+    def stats(self) -> dict:
+        with self._slock:
+            s = self.state
+            return {"t_ms": int(np.asarray(s.t)),
+                    "placed_total": int(np.asarray(s.placed_total)[0]),
+                    "jobs_in_queue": int(np.asarray(s.jobs_in_queue)[0]),
+                    "lent": int(np.asarray(s.lent.count)[0]),
+                    "borrowed": int(np.asarray(s.borrowed.count)[0]),
+                    "running": int(np.asarray(s.run.active).sum()),
+                    "avg_wait_ms": float(np.asarray(st.avg_wait_ms(s))[0])}

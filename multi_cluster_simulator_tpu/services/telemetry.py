@@ -1,0 +1,168 @@
+"""Telemetry: structured logging, spans, metrics — the OTel/zerolog layer.
+
+The reference wires every service with an OTLP span exporter, a periodic
+metric reader, and a zerolog console/file logger via its telemetry factory
+(internal/service/telemetry.go:43-143); no collector ships with the repo, so
+in practice the artifacts are the log files. Here the same three factories
+exist without an external collector dependency:
+
+- ``create_logger`` — structured key=value console/file logging
+  (telemetry.go:121-143: development -> console, production -> file
+  ``logs/<SERVICE_NAME>-log-<timestamp>``, "both" -> both).
+- ``Tracer`` — spans as JSONL records with trace/span ids and a
+  ``traceparent``-style HTTP propagation header (the otelhttp transport
+  equivalent, pkg/scheduler/server.go:47).
+- ``Meter`` — named up/down counters and histograms with a periodic
+  export thread (CreateMeterProvider's PeriodicReader,
+  telemetry.go:94-119); snapshots are JSONL + a Prometheus-style text
+  rendering for a /metrics route.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import secrets
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+TRACE_HEADER = "X-Trace-Context"  # traceparent analogue
+
+
+def create_logger(service_name: str, mode: str = "development",
+                  log_dir: str = "logs") -> logging.Logger:
+    """zerolog factory (telemetry.go:121-143): console in development, file
+    otherwise, both with mode="both"."""
+    logger = logging.getLogger(f"mcs.{service_name}")
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    if logger.handlers:  # idempotent per process
+        return logger
+    fmt = logging.Formatter(
+        "%(asctime)s " + service_name + " %(levelname)s %(message)s")
+    if mode in ("development", "both"):
+        h = logging.StreamHandler()
+        h.setFormatter(fmt)
+        logger.addHandler(h)
+    if mode in ("production", "both"):
+        os.makedirs(log_dir, exist_ok=True)
+        stamp = time.strftime("%Y-%m-%dT%H-%M-%S")
+        h = logging.FileHandler(os.path.join(
+            log_dir, f"{service_name}-log-{stamp}"))
+        h.setFormatter(fmt)
+        logger.addHandler(h)
+    if not logger.handlers:
+        logger.addHandler(logging.NullHandler())
+    return logger
+
+
+class Tracer:
+    """Span recorder. Spans land as JSONL rows in ``path`` (or are dropped
+    when path is None — the no-collector default, matching the reference
+    running without an OTLP endpoint)."""
+
+    def __init__(self, service_name: str, path: Optional[str] = None):
+        self.service = service_name
+        self.path = path
+        self._lock = threading.Lock()
+
+    @contextmanager
+    def start_span(self, name: str, parent: Optional[str] = None, **attrs):
+        """parent is a propagated "trace_id:span_id" context string."""
+        trace_id, _, parent_id = (parent or "").partition(":")
+        trace_id = trace_id or secrets.token_hex(8)
+        span_id = secrets.token_hex(4)
+        ctx = f"{trace_id}:{span_id}"
+        t0 = time.time()
+        try:
+            yield ctx
+        finally:
+            if self.path is not None:
+                row = {"service": self.service, "name": name,
+                       "trace_id": trace_id, "span_id": span_id,
+                       "parent_id": parent_id or None,
+                       "start": t0, "dur_ms": (time.time() - t0) * 1e3, **attrs}
+                with self._lock, open(self.path, "a") as f:
+                    f.write(json.dumps(row) + "\n")
+
+
+class Meter:
+    """Counters + histograms with periodic export.
+
+    The reference declares ``<SERVICE_NAME>_jobs_in_queue`` (up/down counter)
+    and ``<SERVICE_NAME>_waitTime`` (histogram) and records every 5 s
+    (pkg/scheduler/metrics.go:11-31)."""
+
+    _BOUNDS = (10, 50, 100, 500, 1_000, 5_000, 10_000, 60_000, 300_000)
+
+    def __init__(self, service_name: str, export_path: Optional[str] = None,
+                 export_period_s: float = 5.0):
+        self.service = service_name
+        self.export_path = export_path
+        self.export_period_s = export_period_s
+        self._counters: dict[str, float] = {}
+        self._hists: dict[str, list[int]] = {}
+        self._hist_sum: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add(self, name: str, value: float) -> None:
+        """Up/down counter add (Int64UpDownCounter.Add)."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def record(self, name: str, value: float) -> None:
+        """Histogram record (Float64Histogram.Record)."""
+        with self._lock:
+            buckets = self._hists.setdefault(name, [0] * (len(self._BOUNDS) + 1))
+            i = sum(1 for b in self._BOUNDS if value > b)
+            buckets[i] += 1
+            self._hist_sum[name] = self._hist_sum.get(name, 0.0) + value
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"service": self.service, "time": time.time(),
+                    "counters": dict(self._counters),
+                    "histograms": {k: {"buckets": list(v),
+                                       "sum": self._hist_sum.get(k, 0.0),
+                                       "bounds": list(self._BOUNDS)}
+                                   for k, v in self._hists.items()}}
+
+    def render_prometheus(self) -> str:
+        """Prometheus-style text (for a /metrics route)."""
+        snap = self.snapshot()
+        lines = []
+        for k, v in snap["counters"].items():
+            lines.append(f"{self.service}_{k} {v}")
+        for k, h in snap["histograms"].items():
+            acc = 0
+            for bound, n in zip(list(self._BOUNDS) + ["+Inf"], h["buckets"]):
+                acc += n
+                lines.append(f'{self.service}_{k}_bucket{{le="{bound}"}} {acc}')
+            lines.append(f"{self.service}_{k}_sum {h['sum']}")
+            lines.append(f"{self.service}_{k}_count {acc}")
+        return "\n".join(lines) + "\n"
+
+    def start_exporter(self) -> None:
+        """PeriodicReader analogue: append snapshots to export_path."""
+        if self.export_path is None or self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.export_period_s):
+                with open(self.export_path, "a") as f:
+                    f.write(json.dumps(self.snapshot()) + "\n")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"meter:{self.service}")
+        self._thread.start()
+
+    def stop_exporter(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
