@@ -15,7 +15,7 @@ simulated time — per-config speedups vs that bound are in the details file.
 Usage:
   python bench.py                 # headline (north star)
   python bench.py --config NAME   # fifo_small | fifo_two_trader | ffd64 |
-                                  # borg4k | headline
+                                  # sinkhorn | borg4k | headline
   python bench.py --all           # every config; details to bench_results.json
 """
 
@@ -186,6 +186,50 @@ def bench_ffd64(quick=False):
     }
 
 
+def bench_sinkhorn(quick=False):
+    """Config 4: Sinkhorn trader matching, 1k clusters x 100k jobs, 3-dim
+    resources (cpu/mem/gpu). Clusters run hot (expected demand ~2x
+    capacity), so the utilization request-policy fires and the entropic-OT
+    matcher pairs overloaded buyers with idle sellers every monitor round."""
+    from multi_cluster_simulator_tpu.config import (
+        MatchKind, PolicyKind, SimConfig, TraderConfig,
+    )
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+    C, jobs_per = (64, 200) if quick else (1024, 100)
+    horizon_ms = 600_000
+    cfg = SimConfig(policy=PolicyKind.DELAY, parity=False,
+                    max_placements_per_tick=16, queue_capacity=128,
+                    max_running=256, max_arrivals=jobs_per,
+                    max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=2,
+                    trader=TraderConfig(enabled=True,
+                                        matching=MatchKind.SINKHORN,
+                                        carve_mode="sane"))
+    # half the clusters are gpu-rich, half gpu-poor — gpu jobs on poor
+    # clusters can only run on traded virtual nodes
+    specs = [uniform_cluster(c + 1, 5, gpus=8 if c % 2 == 0 else 0)
+             for c in range(C)]
+    arrivals = uniform_stream(C, jobs_per, horizon_ms, max_cores=24,
+                              max_mem=18_000, max_dur_ms=300_000, seed=7,
+                              max_gpus=2, gpu_frac=0.1)
+    n_ticks = horizon_ms // cfg.tick_ms + 100
+    out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks,
+                                         use_mesh=True)
+    placed = int(np.asarray(out.placed_total).sum())
+    vnodes = int(np.asarray(out.node_active)[:, cfg.max_nodes:].sum())
+    assert vnodes > 0, "the sinkhorn market never traded"
+    return {
+        "metric": "sinkhorn_market_jobs_per_sec_1kx100k_3res",
+        "value": round(placed / wall_s, 1),
+        "unit": "jobs/s",
+        "vs_baseline": round((placed / wall_s) / (1_000_000 / 60.0), 3),
+        "detail": {"jobs": placed, "of": C * jobs_per,
+                   "virtual_nodes_traded": vnodes,
+                   "wall_s": round(wall_s, 3), "compile_s": round(compile_s, 1)},
+    }
+
+
 def bench_borg4k(quick=False):
     """Config 5: Borg-2019-shaped trace replay, 4k clusters, mesh-sharded
     when more than one device is available."""
@@ -222,6 +266,7 @@ CONFIGS = {
     "fifo_small": bench_fifo_small,
     "fifo_two_trader": bench_fifo_two_trader,
     "ffd64": bench_ffd64,
+    "sinkhorn": bench_sinkhorn,
     "borg4k": bench_borg4k,
 }
 

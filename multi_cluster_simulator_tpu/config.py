@@ -63,7 +63,8 @@ class TraderConfig:
     state_cadence_ms: int = 5_000  # scheduler state stream, trader_server.go:42
     contract_ttl_ms: int = 20_000  # seller contract validity, trader/server.go:49
     matching: MatchKind = MatchKind.GREEDY
-    sinkhorn_iters: int = 16
+    sinkhorn_iters: int = 16  # entropic-OT iterations (market/trader.py)
+    sinkhorn_eps: float = 0.05  # entropic regularization temperature
     # "asbuilt" reproduces the reference's observable arithmetic (quirks
     # included); "sane" is the documented intended behavior (MARKET.md).
     small_node_sizing: str = "asbuilt"  # scheduler_client.go:201-289

@@ -58,7 +58,7 @@ _STATE_AXES = SimState(
     l0=0, l1=0, ready=0, wait=0, lent=0, borrowed=0, run=0, arr_ptr=0,
     wait_total=0, wait_jobs=0, jobs_in_queue=0, placed_total=0, trader=0, trace=0,
 )
-_ARR_AXES = Arrivals(t=0, id=0, cores=0, mem=0, dur=0, n=0)
+_ARR_AXES = Arrivals(t=0, id=0, cores=0, mem=0, gpu=0, dur=0, n=0)
 
 
 @struct.dataclass
@@ -207,7 +207,7 @@ def _ingest_local(s: SimState, arr: Arrivals, t, cfg: SimConfig, to_delay: bool)
     valid = jnp.logical_and(idx < arr.n, arr.t[safe] <= t)  # prefix mask (sorted)
     rows = Q.from_fields(
         id=arr.id[safe], cores=arr.cores[safe], mem=arr.mem[safe],
-        dur=arr.dur[safe], enq_t=arr.t[safe],
+        gpu=arr.gpu[safe], dur=arr.dur[safe], enq_t=arr.t[safe],
         owner=jnp.full((K,), Q.OWN, jnp.int32),
         rec_wait=jnp.zeros((K,), jnp.int32),
         count=jnp.sum(valid),

@@ -14,8 +14,13 @@ from typing import Sequence
 
 import numpy as np
 
-RES = 2  # resource axis: [cores, memory]
-CORES, MEM = 0, 1
+# Resource axis: [cores, memory, gpus]. The reference tracks only
+# cores/memory (Node, cluster.go:127-138); the gpu axis is the 3-dim
+# extension demanded by BASELINE.json config 4 ("Sinkhorn trader matching,
+# ... 3-dim resources (cpu/mem/gpu)"). Reference-parity configs leave every
+# gpu count at 0, which makes the axis inert (0 >= 0 feasibility).
+RES = 3
+CORES, MEM, GPU = 0, 1, 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -25,6 +30,7 @@ class NodeSpec:
     id: int
     cores: int
     memory: int
+    gpus: int = 0  # 3-dim extension; 0 in every reference asset
     type: str = "physical"
 
 
@@ -55,6 +61,9 @@ class ClusterSpec:
                     "Cores": n.cores,
                     "MemoryAvailable": n.memory,
                     "CoresAvailable": n.cores,
+                    # extension field; absent from the Go struct and ignored
+                    # by Go decoders
+                    "Gpus": n.gpus,
                 }
                 for n in self.nodes
             ],
@@ -74,6 +83,7 @@ def _node_from_json(d: dict) -> NodeSpec:
         id=int(g("Id", "id")),
         cores=int(g("Cores", "cores")),
         memory=int(g("Memory", "memory")),
+        gpus=int(g("Gpus", "gpus", default=0)),
         type=str(g("Type", "type", default="physical")),
     )
 
@@ -89,12 +99,14 @@ def load_cluster_json(path: str) -> ClusterSpec:
         return cluster_from_json(json.load(f))
 
 
-def uniform_cluster(cluster_id: int, n_nodes: int, cores: int = 32, memory: int = 24_000) -> ClusterSpec:
+def uniform_cluster(cluster_id: int, n_nodes: int, cores: int = 32,
+                    memory: int = 24_000, gpus: int = 0) -> ClusterSpec:
     """Synthesize a cluster of identical nodes (the shape of both reference
     assets: 5 or 10 nodes x 32 cores x 24000 MB)."""
     return ClusterSpec(
         id=cluster_id,
-        nodes=tuple(NodeSpec(id=i + 1, cores=cores, memory=memory) for i in range(n_nodes)),
+        nodes=tuple(NodeSpec(id=i + 1, cores=cores, memory=memory, gpus=gpus)
+                    for i in range(n_nodes)),
     )
 
 
@@ -111,4 +123,5 @@ def capacities_array(specs: Sequence[ClusterSpec], max_nodes: int) -> np.ndarray
         for i, n in enumerate(spec.nodes):
             cap[c, i, CORES] = n.cores
             cap[c, i, MEM] = n.memory
+            cap[c, i, GPU] = n.gpus
     return cap

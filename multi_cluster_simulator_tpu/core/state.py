@@ -39,6 +39,7 @@ class Arrivals:
     id: jax.Array  # [C, A] int32
     cores: jax.Array  # [C, A] int32
     mem: jax.Array  # [C, A] int32
+    gpu: jax.Array  # [C, A] int32 (3-dim extension; zeros in parity configs)
     dur: jax.Array  # [C, A] int32 ms
     n: jax.Array  # [C] int32 valid prefix length
 

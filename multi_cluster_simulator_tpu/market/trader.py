@@ -29,8 +29,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from multi_cluster_simulator_tpu.config import SimConfig
-from multi_cluster_simulator_tpu.core.spec import CORES, MEM
+from multi_cluster_simulator_tpu.config import MatchKind, SimConfig
+from multi_cluster_simulator_tpu.core.spec import CORES, GPU, MEM
 from multi_cluster_simulator_tpu.core.state import SimState
 from multi_cluster_simulator_tpu.ops import carve as carve_ops
 from multi_cluster_simulator_tpu.ops import sizing
@@ -52,6 +52,187 @@ def trade_round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
     mcfg = cfg.trader
     do = (t % mcfg.monitor_period_ms) == 0
     return jax.lax.cond(do, lambda s: _round(s, t, cfg, ex), lambda s: s, state)
+
+
+def _match_greedy(state: SimState, tr, t, mcfg, ex, gidx, g_buyer, g_con):
+    """The reference's negotiation, determinized (trader.go:193-278): each
+    seller evaluates only its lowest-index requesting buyer (the
+    one-contract-at-a-time lock, trader/server.go:36-44); per buyer the
+    lowest approving seller whose carve succeeds wins. Returns
+    (winner [C_tot] global seller idx or INF, csel per-local-seller
+    Contract, amounts [C_loc, N, RES], win_sell [C_loc], new_lock)."""
+    C_loc = gidx.shape[0]
+    C_tot = g_buyer.shape[0]
+    INF = jnp.int32(2**31 - 1)
+    bidx = jnp.arange(C_tot, dtype=jnp.int32)
+
+    # ---- sellers (local): one-request-per-round lock + ApproveTrade ----
+    locked = tr.seller_locked_until > t
+    req = jnp.logical_and(g_buyer[None, :], gidx[:, None] != bidx[None, :])  # [s_loc, b]
+    has_req = jnp.any(req, axis=1)
+    b_first = jnp.argmax(req, axis=1).astype(jnp.int32)  # lowest global buyer
+    process = jnp.logical_and(has_req, jnp.logical_not(locked))
+
+    csel = _tree_take(g_con, b_first)  # the contract each local seller evaluates
+    # ApproveTrade (trader.go:141-167), all in float32 against the snapshot:
+    tot_c = tr.snap_total_cores.astype(jnp.float32)
+    tot_m = tr.snap_total_mem.astype(jnp.float32)
+    avail_c = tot_c - tot_c * tr.snap_core_util
+    avail_m = tot_m - tot_m * tr.snap_mem_util
+    t_sec = csel.time_ms.astype(jnp.float32) / 1000.0
+    incentive = (jnp.float32(mcfg.min_core_incentive) * csel.cores.astype(jnp.float32) * t_sec
+                 + jnp.float32(mcfg.min_mem_incentive) * csel.mem.astype(jnp.float32) * t_sec)
+    approve_ok = jnp.logical_and(
+        jnp.logical_and(tr.snap_core_util < mcfg.approve_core_threshold,
+                        tr.snap_mem_util < mcfg.approve_mem_threshold),
+        jnp.logical_and(jnp.logical_and(avail_c >= csel.cores.astype(jnp.float32),
+                                        avail_m >= csel.mem.astype(jnp.float32)),
+                        csel.price >= incentive))
+    approve = jnp.logical_and(process, approve_ok)
+
+    # ---- carve feasibility (ApproveContract -> ProvideVirtualNode) ----
+    amounts, carve_ok = jax.vmap(
+        lambda free, act, ccon: carve_ops.carve_plan(
+            free, act, ccon.cores, ccon.mem, ccon.gpu, mode=mcfg.carve_mode)
+    )(state.node_free, state.node_active, csel)  # [C_loc, N, RES], [C_loc]
+
+    # ---- match: per buyer, lowest approving seller whose carve succeeds;
+    # the min-reduction is the collective form of the offer heap ----
+    cand_ok = jnp.logical_and(approve, carve_ok)  # [s_loc]
+    wmat = jnp.full((C_loc, C_tot), INF, jnp.int32).at[
+        jnp.arange(C_loc), b_first].set(jnp.where(cand_ok, gidx, INF))
+    winner = ex.allmin(jnp.min(wmat, axis=0))  # [C_tot] global seller idx
+    has_winner = winner < INF
+    # sellers the buyer called ApproveContract on: every candidate up to and
+    # including the winner (heap fall-through, trader.go:265-276); all
+    # candidates if none carved. Their currentContract resets immediately
+    # (trader/server.go:83); non-attempted approvers stay locked until TTL.
+    attempted_any = jnp.logical_and(
+        approve, jnp.where(has_winner[b_first], gidx <= winner[b_first], True))
+
+    new_lock = jnp.where(process, t + mcfg.contract_ttl_ms, tr.seller_locked_until)
+    new_lock = jnp.where(attempted_any, 0, new_lock)
+
+    win_sell = jnp.logical_and(cand_ok, winner[b_first] == gidx)
+    return winner, csel, amounts, win_sell, new_lock
+
+
+def _match_sinkhorn(state: SimState, tr, t, mcfg, ex, gidx, g_buyer, g_con):
+    """Batched optimal-transport matching (BASELINE config 4) — the upgrade
+    over the greedy heap: instead of each seller seeing only its first
+    requesting buyer, the full (seller × buyer) feasibility matrix enters an
+    entropic-regularized assignment relaxation (Sinkhorn iterations over the
+    doubly-stochastic constraint set), then rounds to a one-to-one matching.
+    One round can match as many buyer/seller pairs as feasibility allows,
+    where the greedy protocol strands every seller whose first buyer was
+    taken (see tests/test_sinkhorn.py for the 2-buyer/2-seller case).
+
+    Divergences from the greedy path (all deliberate, matching is an
+    *upgrade* knob, not a parity mode):
+    - carve semantics are ``sane`` (min(req, avail) per node) — feasibility
+      is exactly "total free >= request", which batches; the as-built
+      abs-diff walk does not admit a closed-form feasibility test;
+    - no seller TTL locks: a matched seller's capacity is committed in the
+      same tick, so the lock protocol that serializes the Go negotiation
+      has nothing to protect;
+    - the gpu axis participates in capacity feasibility (3-dim resources).
+
+    Under sharding, rows (local sellers × all buyers) are local after the
+    buyer gather; the iteration state is replicated by gathering K, so every
+    shard computes the identical matching deterministically.
+    """
+    C_loc = gidx.shape[0]
+    C_tot = g_buyer.shape[0]
+    INF = jnp.int32(2**31 - 1)
+    bidx = jnp.arange(C_tot, dtype=jnp.int32)
+
+    locked = tr.seller_locked_until > t
+
+    # ---- per-pair feasibility [s_loc, b] ----
+    thresh_ok = jnp.logical_and(tr.snap_core_util < mcfg.approve_core_threshold,
+                                tr.snap_mem_util < mcfg.approve_mem_threshold)
+    tot_c = tr.snap_total_cores.astype(jnp.float32)
+    tot_m = tr.snap_total_mem.astype(jnp.float32)
+    avail_c = tot_c - tot_c * tr.snap_core_util  # [s_loc]
+    avail_m = tot_m - tot_m * tr.snap_mem_util
+    t_sec = g_con.time_ms.astype(jnp.float32) / 1000.0  # [b]
+    incentive = (jnp.float32(mcfg.min_core_incentive) * g_con.cores.astype(jnp.float32)
+                 + jnp.float32(mcfg.min_mem_incentive) * g_con.mem.astype(jnp.float32)) * t_sec
+    approve = jnp.logical_and(
+        jnp.logical_and(thresh_ok, jnp.logical_not(locked))[:, None],
+        jnp.logical_and(
+            jnp.logical_and(avail_c[:, None] >= g_con.cores[None, :].astype(jnp.float32),
+                            avail_m[:, None] >= g_con.mem[None, :].astype(jnp.float32)),
+            (g_con.price >= incentive)[None, :]))
+    # sane-carve feasibility: total free (active nodes) covers the request,
+    # per resource including gpu
+    tot_free = jnp.sum(jnp.where(state.node_active[..., None],
+                                 jnp.maximum(state.node_free, 0), 0),
+                       axis=1)  # [s_loc, RES]
+    req = jnp.stack([g_con.cores, g_con.mem, g_con.gpu], axis=-1)  # [b, RES]
+    cap_ok = jnp.all(tot_free[:, None, :] >= req[None, :, :], axis=-1)
+    feas = jnp.logical_and(jnp.logical_and(approve, cap_ok),
+                           jnp.logical_and(g_buyer[None, :],
+                                           gidx[:, None] != bidx[None, :]))
+
+    # ---- replicate the full matrix and run Sinkhorn ----
+    feas_full = ex.gather(feas)  # [C_tot, C_tot]
+    # buyer value: normalized resource volume (what a matched contract is
+    # worth); sellers are symmetric, the iterations spread buyers across them
+    v = (g_con.cores.astype(jnp.float32)
+         + g_con.mem.astype(jnp.float32) / 1024.0
+         + 4.0 * g_con.gpu.astype(jnp.float32))
+    v = v / jnp.maximum(jnp.max(v), 1.0)
+    # deterministic per-pair jitter breaks exact ties (identical contracts
+    # from several buyers would otherwise produce identical plan columns and
+    # the argmax rounding would collapse every buyer onto one seller); kept
+    # well under the value scale so it only decides degenerate cases
+    sidx = jnp.arange(C_tot, dtype=jnp.float32)
+    jitter = jnp.modf(jnp.sin(sidx[:, None] * 12.9898
+                              + sidx[None, :] * 78.233) * 43758.5453)[0]
+    eps = jnp.float32(mcfg.sinkhorn_eps)
+    score = v[None, :] + jnp.abs(jitter) * (0.5 * eps)
+    K = jnp.where(feas_full, jnp.exp(score / eps), 0.0)
+    tiny = jnp.float32(1e-30)
+
+    def sink_step(uv, _):
+        u, vc = uv
+        u = 1.0 / jnp.maximum(K @ vc, tiny)
+        vc = 1.0 / jnp.maximum(K.T @ u, tiny)
+        return (u, vc), None
+
+    (u, vc), _ = jax.lax.scan(
+        sink_step, (jnp.ones((C_tot,), jnp.float32), jnp.ones((C_tot,), jnp.float32)),
+        None, length=mcfg.sinkhorn_iters)
+    plan = u[:, None] * K * vc[None, :]  # [C_tot s, C_tot b]
+
+    # ---- round to a one-to-one matching: each buyer claims its argmax
+    # seller; each claimed seller keeps its highest-plan claimant ----
+    any_s = jnp.any(feas_full, axis=0)  # [b]
+    cand = jnp.where(any_s, jnp.argmax(plan, axis=0).astype(jnp.int32), INF)
+    claim = jnp.logical_and(cand[None, :] == jnp.arange(C_tot)[:, None],
+                            feas_full)  # [s, b]
+    best_b = jnp.argmax(jnp.where(claim, plan, -1.0), axis=1).astype(jnp.int32)
+    seller_matched = jnp.any(claim, axis=1)
+
+    # ---- local seller views + actual carve (sane mode is exactly the
+    # cap_ok feasibility test, so carve_ok holds for every matched seller) ----
+    sel_b = best_b[gidx]  # my sellers' chosen buyers
+    win_sell = seller_matched[gidx]
+    csel = _tree_take(g_con, sel_b)
+    amounts, carve_ok = jax.vmap(
+        lambda free, act, ccon: carve_ops.carve_plan(
+            free, act, ccon.cores, ccon.mem, ccon.gpu, mode="sane")
+    )(state.node_free, state.node_active, csel)
+    win_sell = jnp.logical_and(win_sell, carve_ok)
+
+    # winner[b] = the global seller that committed to b (INF = unmatched),
+    # assembled from local commitments and min-reduced across shards
+    local_winner = jnp.full((C_tot,), INF, jnp.int32).at[
+        jnp.where(win_sell, sel_b, C_tot)].set(
+        jnp.where(win_sell, gidx, INF), mode="drop")
+    winner = ex.allmin(local_winner)
+    return winner, csel, amounts, win_sell, tr.seller_locked_until
 
 
 def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
@@ -92,57 +273,14 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
     # ---- broadcast requests (the RequestResource fan-out, trader.go:211-229)
     g_buyer = ex.gather(buyer)  # [C_tot]
     g_con = jax.tree.map(ex.gather, con)
-    C_tot = g_buyer.shape[0]
-    bidx = jnp.arange(C_tot, dtype=jnp.int32)
 
-    # ---- sellers (local): one-request-per-round lock + ApproveTrade ----
-    locked = tr.seller_locked_until > t
-    req = jnp.logical_and(g_buyer[None, :], gidx[:, None] != bidx[None, :])  # [s_loc, b]
-    has_req = jnp.any(req, axis=1)
-    b_first = jnp.argmax(req, axis=1).astype(jnp.int32)  # lowest global buyer
-    process = jnp.logical_and(has_req, jnp.logical_not(locked))
-
-    csel = _tree_take(g_con, b_first)  # the contract each local seller evaluates
-    # ApproveTrade (trader.go:141-167), all in float32 against the snapshot:
-    tot_c = tr.snap_total_cores.astype(jnp.float32)
-    tot_m = tr.snap_total_mem.astype(jnp.float32)
-    avail_c = tot_c - tot_c * tr.snap_core_util
-    avail_m = tot_m - tot_m * tr.snap_mem_util
-    t_sec = csel.time_ms.astype(jnp.float32) / 1000.0
-    incentive = (jnp.float32(mcfg.min_core_incentive) * csel.cores.astype(jnp.float32) * t_sec
-                 + jnp.float32(mcfg.min_mem_incentive) * csel.mem.astype(jnp.float32) * t_sec)
-    approve_ok = jnp.logical_and(
-        jnp.logical_and(tr.snap_core_util < mcfg.approve_core_threshold,
-                        tr.snap_mem_util < mcfg.approve_mem_threshold),
-        jnp.logical_and(jnp.logical_and(avail_c >= csel.cores.astype(jnp.float32),
-                                        avail_m >= csel.mem.astype(jnp.float32)),
-                        csel.price >= incentive))
-    approve = jnp.logical_and(process, approve_ok)
-
-    # ---- carve feasibility (ApproveContract -> ProvideVirtualNode) ----
-    amounts, carve_ok = jax.vmap(
-        lambda free, act, ccon: carve_ops.carve_plan(free, act, ccon.cores,
-                                                     ccon.mem, mode=mcfg.carve_mode)
-    )(state.node_free, state.node_active, csel)  # [C_loc, N, RES], [C_loc]
-
-    # ---- match: per buyer, lowest approving seller whose carve succeeds;
-    # the min-reduction is the collective form of the offer heap ----
-    cand_ok = jnp.logical_and(approve, carve_ok)  # [s_loc]
-    wmat = jnp.full((C_loc, C_tot), INF, jnp.int32).at[
-        jnp.arange(C_loc), b_first].set(jnp.where(cand_ok, gidx, INF))
-    winner = ex.allmin(jnp.min(wmat, axis=0))  # [C_tot] global seller idx
+    if mcfg.matching == MatchKind.SINKHORN:
+        winner, csel, amounts, win_sell, new_lock = _match_sinkhorn(
+            state, tr, t, mcfg, ex, gidx, g_buyer, g_con)
+    else:
+        winner, csel, amounts, win_sell, new_lock = _match_greedy(
+            state, tr, t, mcfg, ex, gidx, g_buyer, g_con)
     has_winner = winner < INF
-    # sellers the buyer called ApproveContract on: every candidate up to and
-    # including the winner (heap fall-through, trader.go:265-276); all
-    # candidates if none carved. Their currentContract resets immediately
-    # (trader/server.go:83); non-attempted approvers stay locked until TTL.
-    attempted_any = jnp.logical_and(
-        approve, jnp.where(has_winner[b_first], gidx <= winner[b_first], True))
-
-    new_lock = jnp.where(process, t + mcfg.contract_ttl_ms, tr.seller_locked_until)
-    new_lock = jnp.where(attempted_any, 0, new_lock)
-
-    win_sell = jnp.logical_and(cand_ok, winner[b_first] == gidx)
 
     # ---- apply: seller side — occupy carved amounts as Foreign placeholder
     # jobs for the contract duration (cluster.go:116) ----
@@ -150,12 +288,12 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
         free = free - jnp.where(win, amts, 0)
 
         def add_placeholder(rn, n):
-            occ = jnp.logical_and(win, jnp.logical_or(amts[n, CORES] > 0,
-                                                      amts[n, MEM] > 0))
+            occ = jnp.logical_and(win, jnp.any(amts[n] > 0))
             slot = jnp.argmin(rn.active).astype(jnp.int32)
             ok = jnp.logical_and(occ, jnp.logical_not(rn.active[slot]))
             row = R.make_row(t + ccon.time_ms, n, amts[n, CORES], amts[n, MEM],
-                             PLACEHOLDER_ID, FOREIGN, ccon.time_ms, t)
+                             amts[n, GPU], PLACEHOLDER_ID, FOREIGN,
+                             ccon.time_ms, t)
             return R.RunningSet(
                 data=rn.data.at[slot].set(jnp.where(ok, row, rn.data[slot])),
                 active=rn.active.at[slot].set(
@@ -178,7 +316,7 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
         slot_free = jnp.logical_and(is_v, jnp.logical_not(active))
         slot = jnp.argmax(slot_free).astype(jnp.int32)
         ok = jnp.logical_and(got, jnp.any(slot_free))
-        newcap = jnp.stack([ccon.cores, ccon.mem]).astype(jnp.int32)
+        newcap = jnp.stack([ccon.cores, ccon.mem, ccon.gpu]).astype(jnp.int32)
         cap = cap.at[slot].set(jnp.where(ok, newcap, cap[slot]))
         free_b = free_b.at[slot].set(jnp.where(ok, newcap, free_b[slot]))
         active = active.at[slot].set(jnp.where(ok, True, active[slot]))

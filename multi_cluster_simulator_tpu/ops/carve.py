@@ -24,54 +24,47 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from multi_cluster_simulator_tpu.core.spec import CORES, MEM
+from multi_cluster_simulator_tpu.core.spec import RES
 
 
 def carve_plan(free: jax.Array, active: jax.Array, req_cores, req_mem,
-               mode: str = "asbuilt"):
+               req_gpu=0, mode: str = "asbuilt"):
     """Plan a carve across the node axis.
 
     free: [N, RES] current free resources; active: [N] — the Go walk visits
     every *real* node in order, including virtual ones (``c.Nodes`` has no
     padding), so inactive padded slots must be skipped: an avail=0 slot
     would otherwise zero the remaining request under the as-built abs-diff
-    arithmetic and fake a successful carve. Returns (amounts [N, RES] i32,
-    ok bool) where ok means the request was fully consumed
-    (cluster.go:119-122's error check).
+    arithmetic and fake a successful carve. The per-resource arithmetic is
+    identical for every axis, so it runs vectorized over [RES] (the gpu
+    component is the 3-dim extension; a zero request leaves it inert).
+    Returns (amounts [N, RES] i32, ok bool) where ok means the request was
+    fully consumed (cluster.go:119-122's error check).
     """
     N = free.shape[0]
+    req0 = jnp.stack([jnp.asarray(req_cores, jnp.int32),
+                      jnp.asarray(req_mem, jnp.int32),
+                      jnp.asarray(req_gpu, jnp.int32)])
+    assert req0.shape == (RES,)
 
-    def step(carry, n):
-        rc0, rm0 = carry
-        rc, rm = rc0, rm0
-        avail_c = jnp.maximum(free[n, CORES], 0)
-        avail_m = jnp.maximum(free[n, MEM], 0)
+    def step(req, n):
+        avail = jnp.maximum(free[n], 0)  # [RES]
         if mode == "asbuilt":
             # diff = |req - avail| when req > 0 (cluster.go:96-102)
-            dc = jnp.where(rc > 0, jnp.abs(rc - avail_c), 0)
-            dm = jnp.where(rm > 0, jnp.abs(rm - avail_m), 0)
+            d = jnp.where(req > 0, jnp.abs(req - avail), 0)
             # request decrement (cluster.go:104-114)
-            rc = jnp.where(dc > rc, 0, rc - dc)
-            rm = jnp.where(dm > rm, 0, rm - dm)
+            new_req = jnp.where(d > req, 0, req - d)
             # occupancy, clamped to what the node actually has
-            oc = jnp.clip(dc, 0, avail_c)
-            om = jnp.clip(dm, 0, avail_m)
+            occ = jnp.clip(d, 0, avail)
         elif mode == "sane":
-            oc = jnp.minimum(rc, avail_c)
-            om = jnp.minimum(rm, avail_m)
-            rc = rc - oc
-            rm = rm - om
+            occ = jnp.minimum(req, avail)
+            new_req = req - occ
         else:
             raise ValueError(f"unknown carve mode {mode!r}")
         skip = jnp.logical_not(active[n])
-        rc = jnp.where(skip, rc0, rc)
-        rm = jnp.where(skip, rm0, rm)
-        oc = jnp.where(skip, 0, oc)
-        om = jnp.where(skip, 0, om)
-        return (rc, rm), jnp.stack([oc, om])
+        return (jnp.where(skip, req, new_req),
+                jnp.where(skip, jnp.zeros_like(occ), occ))
 
-    (rc, rm), amounts = jax.lax.scan(
-        step, (req_cores.astype(jnp.int32), req_mem.astype(jnp.int32)),
-        jnp.arange(N, dtype=jnp.int32))
-    ok = jnp.logical_and(rc <= 0, rm <= 0)
+    req, amounts = jax.lax.scan(step, req0, jnp.arange(N, dtype=jnp.int32))
+    ok = jnp.all(req <= 0)
     return amounts.astype(jnp.int32), ok

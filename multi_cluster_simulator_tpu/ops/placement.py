@@ -16,36 +16,41 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from multi_cluster_simulator_tpu.core.spec import CORES, MEM
+from multi_cluster_simulator_tpu.core.spec import CORES, GPU, MEM
 from multi_cluster_simulator_tpu.ops.queues import JobRec
 
 NO_NODE = jnp.int32(-1)
 
 
-def feasible(free: jax.Array, active: jax.Array, cores: jax.Array, mem: jax.Array, strict: bool = False) -> jax.Array:
+def feasible(free: jax.Array, active: jax.Array, cores: jax.Array,
+             mem: jax.Array, gpu=0, strict: bool = False) -> jax.Array:
     """[N] bool feasibility mask.
 
     ``strict=False`` is ScheduleJob's ``>=`` (scheduler.go:131);
     ``strict=True`` is Lend's ``>`` (scheduler.go:197) — the reference is
-    deliberately inconsistent here and we preserve both.
+    deliberately inconsistent here and we preserve both. The gpu axis (a
+    3-dim extension with no reference analogue) is always ``>=`` so that
+    gpu-less nodes stay feasible for gpu-less jobs in both modes.
     """
     if strict:
         ok = jnp.logical_and(free[:, CORES] > cores, free[:, MEM] > mem)
     else:
         ok = jnp.logical_and(free[:, CORES] >= cores, free[:, MEM] >= mem)
+    ok = jnp.logical_and(ok, free[:, GPU] >= gpu)
     return jnp.logical_and(ok, active)
 
 
 def first_fit(free: jax.Array, active: jax.Array, job: JobRec, strict: bool = False) -> jax.Array:
     """Lowest-index feasible node, or NO_NODE. free: [N, RES], active: [N]."""
-    mask = feasible(free, active, job.cores, job.mem, strict=strict)
+    mask = feasible(free, active, job.cores, job.mem, job.gpu, strict=strict)
     idx = jnp.argmax(mask).astype(jnp.int32)  # first True (argmax of bool)
     return jnp.where(jnp.any(mask), idx, NO_NODE)
 
 
 def can_lend(free: jax.Array, active: jax.Array, job: JobRec) -> jax.Array:
     """Lend() feasibility: any node with strictly more free than needed."""
-    return jnp.any(feasible(free, active, job.cores, job.mem, strict=True))
+    return jnp.any(feasible(free, active, job.cores, job.mem, job.gpu,
+                            strict=True))
 
 
 def occupy(free: jax.Array, node: jax.Array, job: JobRec, do: jax.Array) -> jax.Array:

@@ -28,11 +28,12 @@ from flax import struct
 INVALID_ID = jnp.int32(-1)
 OWN = jnp.int32(-1)  # owner value for "my own job" (Ownership == "")
 
-# packed row layout
-NF = 7
-FID, FCORES, FMEM, FDUR, FENQ, FOWNER, FREC = range(NF)
+# packed row layout; (cores, mem, gpu) are contiguous and ordered like the
+# node-tensor resource axis (core/spec.py RES) so ``res`` is one slice
+NF = 8
+FID, FCORES, FMEM, FGPU, FDUR, FENQ, FOWNER, FREC = range(NF)
 
-_INVALID_ROW = jnp.array([-1, 0, 0, 0, 0, -1, 0], jnp.int32)  # id=-1, owner=OWN
+_INVALID_ROW = jnp.array([-1, 0, 0, 0, 0, 0, -1, 0], jnp.int32)  # id=-1, owner=OWN
 
 
 @struct.dataclass
@@ -54,6 +55,10 @@ class JobRec:
         return self.vec[..., FMEM]
 
     @property
+    def gpu(self):
+        return self.vec[..., FGPU]
+
+    @property
     def dur(self):
         return self.vec[..., FDUR]
 
@@ -71,12 +76,13 @@ class JobRec:
 
     @property
     def res(self):
-        """[..., 2] (cores, mem) — matches the node free/cap layout."""
-        return self.vec[..., FCORES:FMEM + 1]
+        """[..., RES] (cores, mem, gpu) — matches the node free/cap layout."""
+        return self.vec[..., FCORES:FGPU + 1]
 
     @staticmethod
-    def make(id=-1, cores=0, mem=0, dur=0, enq_t=0, owner=OWN, rec_wait=0) -> "JobRec":
-        parts = [id, cores, mem, dur, enq_t, owner, rec_wait]
+    def make(id=-1, cores=0, mem=0, gpu=0, dur=0, enq_t=0, owner=OWN,
+             rec_wait=0) -> "JobRec":
+        parts = [id, cores, mem, gpu, dur, enq_t, owner, rec_wait]
         return JobRec(vec=jnp.stack([jnp.asarray(p, jnp.int32) for p in parts], axis=-1))
 
     @staticmethod
@@ -90,7 +96,7 @@ class JobRec:
         return JobRec(vec=vec)
 
 
-_FIDX = {"id": FID, "cores": FCORES, "mem": FMEM, "dur": FDUR,
+_FIDX = {"id": FID, "cores": FCORES, "mem": FMEM, "gpu": FGPU, "dur": FDUR,
          "enq_t": FENQ, "owner": FOWNER, "rec_wait": FREC}
 
 
@@ -115,6 +121,10 @@ class JobQueue:
     @property
     def mem(self):
         return self.data[..., FMEM]
+
+    @property
+    def gpu(self):
+        return self.data[..., FGPU]
 
     @property
     def dur(self):
@@ -142,9 +152,9 @@ def empty(capacity: int) -> JobQueue:
                     count=jnp.int32(0))
 
 
-def from_fields(id, cores, mem, dur, enq_t, owner, rec_wait, count) -> JobQueue:
+def from_fields(id, cores, mem, gpu, dur, enq_t, owner, rec_wait, count) -> JobQueue:
     """Build a queue from per-field [Q] arrays (one stack op)."""
-    data = jnp.stack([id, cores, mem, dur, enq_t, owner, rec_wait],
+    data = jnp.stack([id, cores, mem, gpu, dur, enq_t, owner, rec_wait],
                      axis=-1).astype(jnp.int32)
     return JobQueue(data=data, count=jnp.asarray(count, jnp.int32))
 

@@ -20,11 +20,11 @@ from multi_cluster_simulator_tpu.ops.queues import JobRec
 
 NEVER = jnp.int32(2**31 - 1)
 
-# packed row layout
-RF = 8
-REND, RNODE, RCORES, RMEM, RID, ROWNER, RDUR, RENQ = range(RF)
+# packed row layout; (cores, mem, gpu) contiguous, ordered like spec.RES
+RF = 9
+REND, RNODE, RCORES, RMEM, RGPU, RID, ROWNER, RDUR, RENQ = range(RF)
 
-_INVALID_ROW = jnp.array([NEVER, 0, 0, 0, -1, -1, 0, 0], jnp.int32)
+_INVALID_ROW = jnp.array([NEVER, 0, 0, 0, 0, -1, -1, 0, 0], jnp.int32)
 
 
 @struct.dataclass
@@ -53,6 +53,10 @@ class RunningSet:
         return self.data[..., RMEM]
 
     @property
+    def gpu(self):
+        return self.data[..., RGPU]
+
+    @property
     def id(self):
         return self.data[..., RID]
 
@@ -75,14 +79,14 @@ def empty(capacity: int) -> RunningSet:
         active=jnp.zeros((capacity,), bool))
 
 
-def make_row(end_t, node, cores, mem, id, owner, dur, enq_t) -> jax.Array:
-    parts = [end_t, node, cores, mem, id, owner, dur, enq_t]
+def make_row(end_t, node, cores, mem, gpu, id, owner, dur, enq_t) -> jax.Array:
+    parts = [end_t, node, cores, mem, gpu, id, owner, dur, enq_t]
     return jnp.stack([jnp.asarray(p, jnp.int32) for p in parts], axis=-1)
 
 
 def row_from_job(job: JobRec, node, t) -> jax.Array:
-    return make_row(t + job.dur, node, job.cores, job.mem, job.id, job.owner,
-                    job.dur, job.enq_t)
+    return make_row(t + job.dur, node, job.cores, job.mem, job.gpu, job.id,
+                    job.owner, job.dur, job.enq_t)
 
 
 def start(rs: RunningSet, job: JobRec, node: jax.Array, t: jax.Array, do: jax.Array) -> RunningSet:
@@ -105,7 +109,7 @@ def release(rs: RunningSet, free: jax.Array, t: jax.Array):
     done = jnp.logical_and(rs.active, rs.end_t <= t)
     n_nodes = free.shape[0]
     node_idx = jnp.clip(rs.node, 0, n_nodes - 1)
-    back = jnp.where(done[:, None], rs.data[:, RCORES:RMEM + 1], 0)
+    back = jnp.where(done[:, None], rs.data[:, RCORES:RGPU + 1], 0)
     free = free.at[node_idx].add(back)
     rs = RunningSet(
         data=jnp.where(done[:, None], _INVALID_ROW, rs.data),

@@ -22,17 +22,23 @@ from multi_cluster_simulator_tpu.ops.queues import JobQueue
 
 @struct.dataclass
 class Contract:
-    """ContractRequest (proto/trader.proto:21-28), minus the transport bits."""
+    """ContractRequest (proto/trader.proto:21-28), minus the transport bits.
+
+    ``gpu`` is the 3-dim resource extension (BASELINE config 4); it has no
+    wire field in the reference proto and no price contribution — it sizes
+    and carves like the other axes but trades at cost 0."""
 
     cores: jax.Array  # [] i32
     mem: jax.Array  # [] i32
+    gpu: jax.Array  # [] i32
     time_ms: jax.Array  # [] i32
     price: jax.Array  # [] f32
 
     @staticmethod
     def zero() -> "Contract":
         return Contract(cores=jnp.int32(0), mem=jnp.int32(0),
-                        time_ms=jnp.int32(0), price=jnp.float32(0.0))
+                        gpu=jnp.int32(0), time_ms=jnp.int32(0),
+                        price=jnp.float32(0.0))
 
 
 def _price(cores, mem, time_ms, core_cost, mem_cost):
@@ -53,6 +59,7 @@ def fast_node_contract(l1: JobQueue, budget, core_cost, mem_cost) -> Contract:
     valid = l1.slot_valid()
     cores = jnp.cumsum(jnp.where(valid, l1.cores, 0))
     mem = jnp.cumsum(jnp.where(valid, l1.mem, 0))
+    gpu = jnp.cumsum(jnp.where(valid, l1.gpu, 0))
     time_ms = jax.lax.cummax(jnp.where(valid, l1.dur, 0))
     price = _price(cores, mem, time_ms, core_cost, mem_cost)
     ok = jnp.logical_and(valid, jnp.logical_or(budget < 0, price < budget))
@@ -60,6 +67,7 @@ def fast_node_contract(l1: JobQueue, budget, core_cost, mem_cost) -> Contract:
     has = k >= 0
     g = lambda a, z: jnp.where(has, a[jnp.maximum(k, 0)], z)
     return Contract(cores=g(cores, jnp.int32(0)), mem=g(mem, jnp.int32(0)),
+                    gpu=g(gpu, jnp.int32(0)),
                     time_ms=g(time_ms, jnp.int32(0)), price=g(price, jnp.float32(0.0)))
 
 
@@ -81,11 +89,13 @@ def small_node_contract_asbuilt(l1: JobQueue, budget, core_cost, mem_cost) -> Co
         v = jnp.logical_and(valid[i], jnp.logical_not(stopped))
         nc = c.cores + jnp.where(l1.cores[i] > 0, l1.cores[i], 0)
         nm = c.mem + jnp.where(l1.mem[i] > 0, l1.mem[i], 0)
+        ng = c.gpu + jnp.where(l1.gpu[i] > 0, l1.gpu[i], 0)
         nt = jnp.where(l1.dur[i] > c.time_ms, l1.dur[i], jnp.int32(0))
         np_ = _price(nc, nm, nt, core_cost, mem_cost)
         accept = jnp.logical_and(v, jnp.logical_or(budget < 0, np_ < budget))
         c = Contract(cores=jnp.where(accept, nc, c.cores),
                      mem=jnp.where(accept, nm, c.mem),
+                     gpu=jnp.where(accept, ng, c.gpu),
                      time_ms=jnp.where(accept, nt, c.time_ms),
                      price=jnp.where(accept, np_, c.price))
         stopped = jnp.logical_or(stopped, jnp.logical_and(v, jnp.logical_not(accept)))
@@ -104,6 +114,7 @@ def small_node_contract_sane(l1: JobQueue, budget, core_cost, mem_cost) -> Contr
     valid = l1.slot_valid()
     cores = jax.lax.cummax(jnp.where(valid, l1.cores, 0))
     mem = jax.lax.cummax(jnp.where(valid, l1.mem, 0))
+    gpu = jax.lax.cummax(jnp.where(valid, l1.gpu, 0))
     time_ms = jnp.cumsum(jnp.where(valid, l1.dur, 0))
     price = _price(cores, mem, time_ms, core_cost, mem_cost)
     ok = jnp.logical_and(valid, jnp.logical_or(budget < 0, price < budget))
@@ -111,4 +122,5 @@ def small_node_contract_sane(l1: JobQueue, budget, core_cost, mem_cost) -> Contr
     has = k >= 0
     g = lambda a, z: jnp.where(has, a[jnp.maximum(k, 0)], z)
     return Contract(cores=g(cores, jnp.int32(0)), mem=g(mem, jnp.int32(0)),
+                    gpu=g(gpu, jnp.int32(0)),
                     time_ms=g(time_ms, jnp.int32(0)), price=g(price, jnp.float32(0.0)))

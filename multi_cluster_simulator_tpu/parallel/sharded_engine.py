@@ -36,7 +36,8 @@ def _state_specs(axis: str):
 
 def _arr_specs(axis: str):
     shard = P(axis)
-    return Arrivals(t=shard, id=shard, cores=shard, mem=shard, dur=shard, n=shard)
+    return Arrivals(t=shard, id=shard, cores=shard, mem=shard, gpu=shard,
+                    dur=shard, n=shard)
 
 
 class ShardedEngine:

@@ -22,7 +22,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from multi_cluster_simulator_tpu.core.spec import CORES, MEM
+from multi_cluster_simulator_tpu.core.spec import CORES, GPU, MEM
 from multi_cluster_simulator_tpu.core.state import SimState
 from multi_cluster_simulator_tpu.market.trader import FOREIGN, PLACEHOLDER_ID
 from multi_cluster_simulator_tpu.ops import carve as carve_ops
@@ -95,12 +95,11 @@ def carve_occupy(state: SimState, cores, mem, dur_ms,
     dur = jnp.asarray(dur_ms, jnp.int32)
 
     def add_placeholder(rn, n):
-        occ = jnp.logical_and(ok, jnp.logical_or(amounts[n, CORES] > 0,
-                                                 amounts[n, MEM] > 0))
+        occ = jnp.logical_and(ok, jnp.any(amounts[n] > 0))
         slot = jnp.argmin(rn.active).astype(jnp.int32)
         okk = jnp.logical_and(occ, jnp.logical_not(rn.active[slot]))
         row = R.make_row(t + dur, n, amounts[n, CORES], amounts[n, MEM],
-                         PLACEHOLDER_ID, FOREIGN, dur, t)
+                         amounts[n, GPU], PLACEHOLDER_ID, FOREIGN, dur, t)
         return R.RunningSet(
             data=rn.data.at[slot].set(jnp.where(okk, row, rn.data[slot])),
             active=rn.active.at[slot].set(
@@ -127,7 +126,8 @@ def add_virtual_node(state: SimState, cores, mem, dur_ms, vstart: int,
     slot = jnp.argmax(slot_free).astype(jnp.int32)
     ok = jnp.any(slot_free)
     newcap = jnp.stack([jnp.asarray(cores, jnp.int32),
-                        jnp.asarray(mem, jnp.int32)])
+                        jnp.asarray(mem, jnp.int32),
+                        jnp.zeros((), jnp.int32)])
     cap0 = cap0.at[slot].set(jnp.where(ok, newcap, cap0[slot]))
     free0 = free0.at[slot].set(jnp.where(ok, newcap, free0[slot]))
     act0 = act0.at[slot].set(jnp.where(ok, True, act0[slot]))

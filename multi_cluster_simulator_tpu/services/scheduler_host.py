@@ -82,7 +82,7 @@ class SchedulerService(Service):
         # host-side arrival staging ring ([1, A] to match the engine shapes)
         A = cfg.max_arrivals
         self._arr = {k: np.zeros((1, A), np.int32)
-                     for k in ("t", "id", "cores", "mem", "dur")}
+                     for k in ("t", "id", "cores", "mem", "gpu", "dur")}
         self._arr_n = 0
         # submit handlers append here without touching the device lock;
         # the tick thread drains it (so an in-flight compile or device step
@@ -216,7 +216,7 @@ class SchedulerService(Service):
     def _arrivals_device(self) -> Arrivals:
         return Arrivals(
             t=self._arr["t"], id=self._arr["id"], cores=self._arr["cores"],
-            mem=self._arr["mem"], dur=self._arr["dur"],
+            mem=self._arr["mem"], gpu=self._arr["gpu"], dur=self._arr["dur"],
             n=np.array([self._arr_n], np.int32))
 
     # ------------------------------------------------------------------

@@ -42,14 +42,16 @@ def check_conservation(state: SimState) -> None:
     r_node = np.asarray(run.node)
     r_cores = np.asarray(run.cores)
     r_mem = np.asarray(run.mem)
+    r_gpu = np.asarray(run.gpu)
     r_act = np.asarray(run.active)
     C, N, _ = free.shape
-    used = np.zeros((C, N, 2), np.int64)
+    used = np.zeros((C, N, 3), np.int64)
     for c in range(C):
         for s in range(r_node.shape[1]):
             if r_act[c, s]:
                 used[c, r_node[c, s], 0] += r_cores[c, s]
                 used[c, r_node[c, s], 1] += r_mem[c, s]
+                used[c, r_node[c, s], 2] += r_gpu[c, s]
     assert (free >= 0).all(), "negative free resources"
     recon = free + used
     mism = (recon != cap) & active[..., None]

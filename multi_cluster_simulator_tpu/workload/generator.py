@@ -85,4 +85,5 @@ def generate_arrivals(
             rng.beta(cfg.beta_alpha, cfg.beta_beta, n) * max_mem).astype(np.int32)
         out_dur[c, :n] = (rng.integers(0, cfg.max_duration_s, n) * 1_000).astype(np.int32)
 
-    return Arrivals(t=out_t, id=out_id, cores=out_cores, mem=out_mem, dur=out_dur, n=out_n)
+    return Arrivals(t=out_t, id=out_id, cores=out_cores, mem=out_mem,
+                    gpu=np.zeros((C, A), np.int32), dur=out_dur, n=out_n)

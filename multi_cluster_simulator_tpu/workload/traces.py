@@ -19,28 +19,36 @@ import numpy as np
 from multi_cluster_simulator_tpu.core.state import Arrivals
 
 
-def _pack(t, cores, mem, dur):
+def _pack(t, cores, mem, dur, gpu=None):
     C, A = t.shape
     order = np.argsort(t, axis=1, kind="stable")
     g = lambda a: np.take_along_axis(a, order, axis=1).astype(np.int32)
     return Arrivals(
         t=g(t), id=np.broadcast_to(np.arange(A, dtype=np.int32), (C, A)).copy(),
-        cores=g(cores), mem=g(mem), dur=g(dur),
-        n=np.full((C,), A, np.int32))
+        cores=g(cores), mem=g(mem),
+        gpu=np.zeros((C, A), np.int32) if gpu is None else g(gpu),
+        dur=g(dur), n=np.full((C,), A, np.int32))
 
 
 def uniform_stream(n_clusters: int, jobs_per_cluster: int, horizon_ms: int,
                    max_cores: int, max_mem: int, max_dur_ms: int,
-                   seed: int = 0, beta: float = 2.0) -> Arrivals:
+                   seed: int = 0, beta: float = 2.0,
+                   max_gpus: int = 0, gpu_frac: float = 0.0) -> Arrivals:
     """Sorted-uniform arrivals; Beta(b,b) sizes (the reference's job-size
-    family, client.go:87-99); uniform durations."""
+    family, client.go:87-99); uniform durations. With ``max_gpus > 0``, a
+    ``gpu_frac`` fraction of jobs additionally request 1..max_gpus
+    accelerators (the 3-dim-resource workload of BASELINE config 4)."""
     rng = np.random.Generator(np.random.PCG64(seed))
     C, A = n_clusters, jobs_per_cluster
     t = rng.integers(0, horizon_ms, (C, A))
     cores = np.floor(rng.beta(beta, beta, (C, A)) * max_cores)
     mem = np.floor(rng.beta(beta, beta, (C, A)) * max_mem)
     dur = rng.integers(0, max_dur_ms, (C, A))
-    return _pack(t, cores, mem, dur)
+    gpu = None
+    if max_gpus > 0:
+        gpu = np.where(rng.random((C, A)) < gpu_frac,
+                       rng.integers(1, max_gpus + 1, (C, A)), 0)
+    return _pack(t, cores, mem, dur, gpu)
 
 
 def borg_like_stream(n_clusters: int, jobs_per_cluster: int, horizon_ms: int,
@@ -65,8 +73,9 @@ def borg_like_stream(n_clusters: int, jobs_per_cluster: int, horizon_ms: int,
     return _pack(t, cores, mem, dur)
 
 
-def from_arrays(t_ms, cores, mem, dur_ms) -> Arrivals:
+def from_arrays(t_ms, cores, mem, dur_ms, gpus=None) -> Arrivals:
     """Replay an externally loaded trace (e.g. parsed Borg CSV) — inputs are
     [C, A] arrays; times need not be sorted."""
     return _pack(np.asarray(t_ms), np.asarray(cores), np.asarray(mem),
-                 np.asarray(dur_ms))
+                 np.asarray(dur_ms),
+                 None if gpus is None else np.asarray(gpus))

@@ -67,7 +67,7 @@ class TestCarve:
         # Go walk: req (10, 0) over nodes avail [(8,_), (4,_)]:
         #   node0: diff = |10-8| = 2; 2 > 10? no -> req 8; occupy 2
         #   node1: diff = |8-4| = 4; req 4; occupy 4
-        free = jnp.array([[8, 50], [4, 50], [0, 0]], jnp.int32)
+        free = jnp.array([[8, 50, 0], [4, 50, 0], [0, 0, 0]], jnp.int32)
         active = jnp.array([True, True, True])
         amounts, ok = carve_plan(free, active, jnp.int32(10), jnp.int32(0), mode="asbuilt")
         assert amounts[:, 0].tolist() == [2, 4, 0]
@@ -77,14 +77,14 @@ class TestCarve:
         assert bool(ok)
 
     def test_sane_carve(self):
-        free = jnp.array([[8, 50], [4, 50]], jnp.int32)
+        free = jnp.array([[8, 50, 0], [4, 50, 0]], jnp.int32)
         active = jnp.array([True, True])
         amounts, ok = carve_plan(free, active, jnp.int32(10), jnp.int32(60), mode="sane")
-        assert amounts.tolist() == [[8, 50], [2, 10]]
+        assert amounts.tolist() == [[8, 50, 0], [2, 10, 0]]
         assert bool(ok)
 
     def test_sane_carve_infeasible(self):
-        free = jnp.array([[2, 5]], jnp.int32)
+        free = jnp.array([[2, 5, 0]], jnp.int32)
         _, ok = carve_plan(free, jnp.array([True]), jnp.int32(10), jnp.int32(0), mode="sane")
         assert not bool(ok)
 
@@ -110,8 +110,11 @@ def assert_market_state_equal(state, oracle):
     for c in range(C):
         assert got[c] == want[c], f"cluster {c} trace diverged"
         cl = oracle.clusters[c]
-        assert np.asarray(state.node_cap[c]).tolist() == cl.cap
-        assert np.asarray(state.node_free[c]).tolist() == cl.free
+        # the oracle models the reference's two resources; the engine's gpu
+        # column (3-dim extension) stays zero in parity configs
+        assert np.asarray(state.node_cap[c, :, :2]).tolist() == cl.cap
+        assert np.asarray(state.node_free[c, :, :2]).tolist() == cl.free
+        assert not np.asarray(state.node_cap[c, :, 2:]).any()
         assert np.asarray(state.node_active[c]).tolist() == cl.active
         assert int(state.trader.cooldown_until[c]) == cl.cooldown_until
         assert int(state.trader.seller_locked_until[c]) == cl.seller_locked_until

@@ -84,9 +84,9 @@ class TestPlacement:
         assert not bool(P.can_lend(free, active, j))  # > fails
 
     def test_occupy(self):
-        free = jnp.array([[4, 500], [8, 100]], jnp.int32)
+        free = jnp.array([[4, 500, 0], [8, 100, 0]], jnp.int32)
         f2 = P.occupy(free, jnp.int32(1), job(cores=2, mem=50), jnp.bool_(True))
-        assert f2.tolist() == [[4, 500], [6, 50]]
+        assert f2.tolist() == [[4, 500, 0], [6, 50, 0]]
         f3 = P.occupy(free, jnp.int32(1), job(cores=2, mem=50), jnp.bool_(False))
         assert f3.tolist() == free.tolist()
 
@@ -101,7 +101,7 @@ class TestPlacement:
 class TestRunset:
     def test_start_release_roundtrip(self):
         rs = R.empty(4)
-        free = jnp.array([[8, 500]], jnp.int32)
+        free = jnp.array([[8, 500, 0]], jnp.int32)
         j = job(1, cores=3, mem=100, dur=5000)
         free = P.occupy(free, jnp.int32(0), j, jnp.bool_(True))
         rs = R.start(rs, j, jnp.int32(0), jnp.int32(1000), jnp.bool_(True))
@@ -110,15 +110,15 @@ class TestRunset:
         assert not bool(done.any())
         rs, free, done = R.release(rs, free, jnp.int32(6000))
         assert bool(done[0])
-        assert free.tolist() == [[8, 500]]
+        assert free.tolist() == [[8, 500, 0]]
         assert not bool(rs.active.any())
 
     def test_release_multiple_same_node(self):
         rs = R.empty(4)
-        free = jnp.array([[2, 300]], jnp.int32)
+        free = jnp.array([[2, 300, 0]], jnp.int32)
         for i, (c, m) in enumerate([(3, 100), (3, 100)]):
             rs = R.start(rs, job(i, cores=c, mem=m, dur=1000), jnp.int32(0),
                          jnp.int32(0), jnp.bool_(True))
         rs, free, done = R.release(rs, free, jnp.int32(1000))
         assert int(done.sum()) == 2
-        assert free.tolist() == [[8, 500]]
+        assert free.tolist() == [[8, 500, 0]]
