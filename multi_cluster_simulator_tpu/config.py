@@ -95,6 +95,11 @@ class SimConfig:
     """Engine-level configuration."""
 
     # --- capacities (static tensor shapes) ---
+    # Width of the node resource axis: 2 = [cores, mem] (the reference's
+    # Node, cluster.go:127-138), 3 = [cores, mem, gpu] (the BASELINE.json
+    # config-4 extension). Narrowing to 2 shrinks every node tensor and
+    # feasibility compare on gpu-free configs; the trader market requires 3.
+    n_res: int = 3
     max_nodes: int = 8  # physical node slots per cluster
     max_virtual_nodes: int = 4  # reserved slots for borrowed virtual nodes
     queue_capacity: int = 128  # per-queue job slots

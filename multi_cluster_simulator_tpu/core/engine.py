@@ -196,28 +196,47 @@ def _deliver_returns(state: SimState, rows, take, ex) -> SimState:
 # phase 3: arrivals
 # --------------------------------------------------------------------------
 
-def _ingest_local(s: SimState, arr: Arrivals, t, cfg: SimConfig, to_delay: bool):
+def pack_arrivals(arr: Arrivals) -> tuple[jax.Array, jax.Array]:
+    """Pre-stack the arrival stream as ready-made queue rows [C, A, Q.NF].
+
+    Done once per run (outside the tick scan): the per-tick ingest then
+    extracts its window with a one-hot contraction instead of six batched
+    gathers — TPU gathers serialize and were the single largest per-tick
+    cost at 4k clusters."""
+    own = jnp.full(arr.t.shape, Q.OWN, jnp.int32)
+    zero = jnp.zeros(arr.t.shape, jnp.int32)
+    rows = jnp.stack([arr.id, arr.cores, arr.mem, arr.gpu, arr.dur, arr.t,
+                      own, zero], axis=-1).astype(jnp.int32)
+    return rows, arr.n
+
+
+def _ingest_local(s: SimState, arr_rows: jax.Array, arr_n: jax.Array, t,
+                  cfg: SimConfig, to_delay: bool):
     """Enqueue arrivals with arr_t <= t. DELAY path appends to Level0 and
     starts the wait timer + JobsCount + jobs_in_queue counter (the /delay
     handler, server.go:53-78); FIFO path appends to ReadyQueue (the /
-    handler, server.go:23-51)."""
-    K = min(cfg.max_ingest_per_tick, arr.t.shape[-1])
-    idx = s.arr_ptr + jnp.arange(K, dtype=jnp.int32)
-    safe = jnp.clip(idx, 0, arr.t.shape[-1] - 1)
-    valid = jnp.logical_and(idx < arr.n, arr.t[safe] <= t)  # prefix mask (sorted)
-    rows = Q.from_fields(
-        id=arr.id[safe], cores=arr.cores[safe], mem=arr.mem[safe],
-        gpu=arr.gpu[safe], dur=arr.dur[safe], enq_t=arr.t[safe],
-        owner=jnp.full((K,), Q.OWN, jnp.int32),
-        rec_wait=jnp.zeros((K,), jnp.int32),
-        count=jnp.sum(valid),
-    )
-    n = rows.count
+    handler, server.go:23-51).
+
+    ``arr_rows``: [A, Q.NF] pre-packed queue rows (pack_arrivals), enq_t
+    column = arrival time. The window [arr_ptr, arr_ptr+K) is extracted as a
+    one-hot matmul (no gather)."""
+    A = arr_rows.shape[0]
+    K = min(cfg.max_ingest_per_tick, A)
+    a = jnp.arange(A, dtype=jnp.int32)
+    in_window = jnp.logical_and(a >= s.arr_ptr, a < s.arr_ptr + K)
+    elig = jnp.logical_and(
+        jnp.logical_and(in_window, a < arr_n),
+        arr_rows[:, Q.FENQ] <= t)  # prefix of the window (time-sorted)
+    n = jnp.sum(elig).astype(jnp.int32)
+    hot = (a[None, :] == (s.arr_ptr + jnp.arange(K, dtype=jnp.int32))[:, None])
+    rows = hot.astype(arr_rows.dtype) @ arr_rows  # [K, NF]
+    valid = jnp.arange(K, dtype=jnp.int32) < n
+    batch = Q.JobQueue(data=rows, count=n)
     if to_delay:
-        q = Q.push_many(s.l0, rows, valid, prefix=True)
+        q = Q.push_many(s.l0, batch, valid, prefix=True)
         s = s.replace(l0=q, wait_jobs=s.wait_jobs + n, jobs_in_queue=s.jobs_in_queue + n)
     else:
-        q = Q.push_many(s.ready, rows, valid, prefix=True)
+        q = Q.push_many(s.ready, batch, valid, prefix=True)
         s = s.replace(ready=q)
     return s.replace(arr_ptr=s.arr_ptr + n)
 
@@ -236,26 +255,34 @@ def _delay_local(s: SimState, t, cfg: SimConfig):
     QC = cfg.queue_capacity if cfg.parity else min(
         cfg.queue_capacity, cfg.max_placements_per_tick)
 
-    # ---- Level1 sweep ----
-    def step(carry, i):
-        s, rec, placed, skip_next = carry
-        process = jnp.logical_and(i < s.l1.count, jnp.logical_not(skip_next))
-        job = Q.get(s.l1, i).with_(rec_wait=rec[i])
-        total, new_rec = _record_wait(s.wait_total, rec[i], job.enq_t, t, process)
-        rec = rec.at[i].set(new_rec)
-        s = s.replace(wait_total=total)
-        s, success = _attempt(s, job, t, process, st.SRC_L1, cfg.record_trace)
-        s = s.replace(jobs_in_queue=s.jobs_in_queue - success.astype(jnp.int32))
-        placed = placed.at[i].set(success)
+    # ---- Level1 sweep: a bounded while loop — under vmap it runs only
+    # max-over-clusters(|Level1|) iterations, so an idle constellation pays
+    # ~nothing and parity mode costs the same as the capped fast mode ----
+    n_sweep = jnp.minimum(s.l1.count, QC)
+
+    def cond(carry):
+        s2, i, rec, placed, skip_next = carry
+        return i < n_sweep
+
+    def step(carry):
+        s2, i, rec, placed, skip_next = carry
+        process = jnp.logical_and(i < n_sweep, jnp.logical_not(skip_next))
+        job = Q.get(s2.l1, i).with_(rec_wait=rec[i])
+        total, new_rec = _record_wait(s2.wait_total, rec[i], job.enq_t, t, process)
+        rec = rec.at[i].set(jnp.where(process, new_rec, rec[i]))
+        s2 = s2.replace(wait_total=total)
+        s2, success = _attempt(s2, job, t, process, st.SRC_L1, cfg.record_trace)
+        s2 = s2.replace(jobs_in_queue=s2.jobs_in_queue - success.astype(jnp.int32))
+        placed = placed.at[i].set(jnp.where(process, success, placed[i]))
         # Parity: Go removes L1[i] in place and `i++` skips the element that
         # slides into position i (scheduler.go:319) — equivalent on the
         # original order to "after a success, skip the next element".
         skip_next = success if cfg.parity else jnp.zeros((), bool)
-        return (s, rec, placed, skip_next), None
+        return (s2, i + 1, rec, placed, skip_next)
 
-    init = (s, s.l1.rec_wait, jnp.zeros((cfg.queue_capacity,), bool),
-            jnp.zeros((), bool))
-    (s, rec, placed, _), _ = jax.lax.scan(step, init, jnp.arange(QC, dtype=jnp.int32))
+    init = (s, jnp.int32(0), s.l1.rec_wait,
+            jnp.zeros((cfg.queue_capacity,), bool), jnp.zeros((), bool))
+    s, _, rec, placed, _ = jax.lax.while_loop(cond, step, init)
     l1 = Q.compact(Q.set_col(s.l1, Q.FREC, rec), jnp.logical_not(placed))
     s = s.replace(l1=l1)
 
@@ -286,22 +313,28 @@ def _ffd_local(s: SimState, t, cfg: SimConfig):
     QC = cfg.queue_capacity if cfg.parity else min(
         cfg.queue_capacity, cfg.max_placements_per_tick)
     order = P.best_fit_decreasing_order(s.l0.cores, s.l0.mem, s.l0.slot_valid())
+    n_sweep = jnp.minimum(s.l0.count, QC)  # order puts valid slots first
 
-    def step(carry, k):
-        s, placed = carry
+    def cond(carry):
+        s2, k, placed = carry
+        return k < n_sweep
+
+    def step(carry):
+        s2, k, placed = carry
         i = order[k]
-        process = i < s.l0.count
-        job = Q.get(s.l0, i)
-        total, new_rec = _record_wait(s.wait_total, job.rec_wait, job.enq_t, t, process)
-        s = s.replace(wait_total=total,
-                      l0=s.l0.replace(data=s.l0.data.at[i, Q.FREC].set(new_rec)))
-        s, success = _attempt(s, job, t, process, st.SRC_L0, cfg.record_trace)
-        s = s.replace(jobs_in_queue=s.jobs_in_queue - success.astype(jnp.int32))
-        placed = placed.at[i].set(success)
-        return (s, placed), None
+        process = k < n_sweep
+        job = Q.get(s2.l0, i)
+        total, new_rec = _record_wait(s2.wait_total, job.rec_wait, job.enq_t, t, process)
+        s2 = s2.replace(wait_total=total,
+                        l0=s2.l0.replace(data=s2.l0.data.at[i, Q.FREC].set(
+                            jnp.where(process, new_rec, s2.l0.data[i, Q.FREC]))))
+        s2, success = _attempt(s2, job, t, process, st.SRC_L0, cfg.record_trace)
+        s2 = s2.replace(jobs_in_queue=s2.jobs_in_queue - success.astype(jnp.int32))
+        placed = placed.at[i].set(jnp.where(process, success, placed[i]))
+        return (s2, k + 1, placed)
 
-    (s, placed), _ = jax.lax.scan(step, (s, jnp.zeros((cfg.queue_capacity,), bool)),
-                                  jnp.arange(QC, dtype=jnp.int32))
+    s, _, placed = jax.lax.while_loop(
+        cond, step, (s, jnp.int32(0), jnp.zeros((cfg.queue_capacity,), bool)))
     return s.replace(l0=Q.compact(s.l0, jnp.logical_not(placed)))
 
 
@@ -318,26 +351,34 @@ def _fifo_local(s: SimState, t, cfg: SimConfig):
     wait_active = s.wait.count > 0
 
     # ---- ready drain (only when the wait queue is empty): place from the
-    # head until the first failure; the failing job moves to WaitQueue ----
-    def dstep(carry, i):
-        s, stopped, taken, fail_job, any_fail = carry
+    # head until the first failure; the failing job moves to WaitQueue.
+    # Bounded while loop — exits as soon as every cluster drained/stopped ----
+    def dcond(carry):
+        s2, i, stopped, n_taken, fail_job, any_fail = carry
+        return jnp.logical_and(
+            jnp.logical_not(wait_active),
+            jnp.logical_and(i < jnp.minimum(s2.ready.count, QC),
+                            jnp.logical_not(stopped)))
+
+    def dstep(carry):
+        s2, i, stopped, n_taken, fail_job, any_fail = carry
         process = jnp.logical_and(
             jnp.logical_not(wait_active),
-            jnp.logical_and(i < s.ready.count, jnp.logical_not(stopped)))
-        job = Q.get(s.ready, i)
-        s, success = _attempt(s, job, t, process, st.SRC_READY, cfg.record_trace)
+            jnp.logical_and(i < jnp.minimum(s2.ready.count, QC),
+                            jnp.logical_not(stopped)))
+        job = Q.get(s2.ready, i)
+        s2, success = _attempt(s2, job, t, process, st.SRC_READY, cfg.record_trace)
         fail = jnp.logical_and(process, jnp.logical_not(success))
-        taken = taken.at[i].set(process)  # pops regardless of outcome
+        n_taken = n_taken + process.astype(jnp.int32)  # pops regardless of outcome
         fail_job = jax.tree.map(lambda a, b: jnp.where(fail, b, a), fail_job, job)
-        return (s, jnp.logical_or(stopped, fail), taken, fail_job,
-                jnp.logical_or(any_fail, fail)), None
+        return (s2, i + 1, jnp.logical_or(stopped, fail), n_taken, fail_job,
+                jnp.logical_or(any_fail, fail))
 
-    init = (s, jnp.zeros((), bool), jnp.zeros((QC,), bool), Q.JobRec.invalid(),
-            jnp.zeros((), bool))
-    (s, _, taken, fail_job, any_fail), _ = jax.lax.scan(
-        dstep, init, jnp.arange(QC, dtype=jnp.int32))
+    init = (s, jnp.int32(0), jnp.zeros((), bool), jnp.int32(0),
+            Q.JobRec.invalid(), jnp.zeros((), bool))
+    s, _, _, n_taken, fail_job, any_fail = jax.lax.while_loop(dcond, dstep, init)
     # the drain consumes a strict prefix of the ready queue
-    s = s.replace(ready=Q.pop_front_n(s.ready, jnp.sum(taken).astype(jnp.int32)),
+    s = s.replace(ready=Q.pop_front_n(s.ready, n_taken),
                   wait=Q.push_back(s.wait, fail_job, any_fail))
 
     # ---- wait-head attempt (the branch at scheduler.go:219-252) ----
@@ -451,6 +492,11 @@ class Engine:
         from multi_cluster_simulator_tpu.parallel.exchange import LocalExchange
         self.cfg = cfg
         self.ex = ex if ex is not None else LocalExchange()
+        if cfg.n_res not in (2, 3):
+            raise ValueError(f"n_res must be 2 or 3, got {cfg.n_res}")
+        if cfg.trader.enabled and cfg.n_res != 3:
+            raise ValueError("the trader market carves 3-dim resources; "
+                             "set n_res=3 when trader.enabled")
         if cfg.trader.enabled:
             try:
                 from multi_cluster_simulator_tpu.market import trader as market
@@ -465,10 +511,16 @@ class Engine:
 
     # -- single tick (pure; vmap/global composition) --
     def tick(self, state: SimState, arrivals: Arrivals) -> SimState:
-        return self.tick_io(state, arrivals)[0]
+        return self._tick(state, pack_arrivals(arrivals), emit_io=False)[0]
 
     def tick_io(self, state: SimState, arrivals: Arrivals) -> tuple[SimState, TickIO]:
         """One tick, also returning the host-visible TickIO events."""
+        return self._tick(state, pack_arrivals(arrivals), emit_io=True)
+
+    def _tick(self, state: SimState, packed_arrivals, emit_io: bool):
+        """The tick body. ``emit_io=False`` (the batch/scan path) skips the
+        TickIO packing work when borrowing doesn't need it — the return-slot
+        argsort is per-tick cost the headline config shouldn't pay."""
         cfg = self.cfg
         t = state.t + cfg.tick_ms
 
@@ -477,7 +529,12 @@ class Engine:
         st2, done = jax.vmap(_release_local, in_axes=(_STATE_AXES, None),
                              out_axes=(_STATE_AXES, 0))(state, t)
         state = st2
-        ret_rows, ret_valid = _pack_returns(run_before, done, cfg.max_msgs)
+        if cfg.borrowing or emit_io:
+            ret_rows, ret_valid = _pack_returns(run_before, done, cfg.max_msgs)
+        else:
+            C = done.shape[0]
+            ret_rows = jnp.zeros((C, cfg.max_msgs, R.RF), jnp.int32)
+            ret_valid = jnp.zeros((C, cfg.max_msgs), bool)
         if cfg.borrowing:
             state = _deliver_returns(state, ret_rows, ret_valid, self.ex)
 
@@ -487,10 +544,11 @@ class Engine:
                              out_axes=_STATE_AXES)(state, t)
 
         # 3. arrivals
+        arr_rows, arr_n = packed_arrivals
         to_delay = cfg.policy in (PolicyKind.DELAY, PolicyKind.FFD)
         state = jax.vmap(functools.partial(_ingest_local, cfg=cfg, to_delay=to_delay),
-                         in_axes=(_STATE_AXES, _ARR_AXES, None),
-                         out_axes=_STATE_AXES)(state, arrivals, t)
+                         in_axes=(_STATE_AXES, 0, 0, None),
+                         out_axes=_STATE_AXES)(state, arr_rows, arr_n, t)
 
         # 4. scheduling pass
         C = state.arr_ptr.shape[0]
@@ -522,13 +580,15 @@ class Engine:
             state = self._trade_round(state, t)
 
         io = TickIO(borrow_want=want, borrow_job=bjob_vec,
-                    ret_rows=ret_rows, ret_valid=ret_valid)
+                    ret_rows=ret_rows, ret_valid=ret_valid) if emit_io else None
         return state.replace(t=t), io
 
     # -- scan driver --
     def run(self, state: SimState, arrivals: Arrivals, n_ticks: int) -> SimState:
+        packed = pack_arrivals(arrivals)  # once, outside the tick scan
+
         def body(s, _):
-            return self.tick(s, arrivals), None
+            return self._tick(s, packed, emit_io=False)[0], None
 
         state, _ = jax.lax.scan(body, state, None, length=n_ticks)
         return state

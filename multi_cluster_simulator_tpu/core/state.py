@@ -137,8 +137,11 @@ def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec]) -> SimState:
     C = len(specs)
     N = cfg.total_nodes
     cap_phys = capacities_array(specs, cfg.max_nodes)  # [C, max_nodes, RES]
-    cap = np.zeros((C, N, RES), dtype=np.int32)
-    cap[:, : cfg.max_nodes] = cap_phys
+    if cfg.n_res < RES and cap_phys[..., cfg.n_res:].any():
+        raise ValueError(
+            f"specs declare gpu capacity but n_res={cfg.n_res} drops the axis")
+    cap = np.zeros((C, N, cfg.n_res), dtype=np.int32)
+    cap[:, : cfg.max_nodes] = cap_phys[..., : cfg.n_res]
     active = (cap.sum(-1) > 0)
 
     def batched_queue():

@@ -30,13 +30,19 @@ def feasible(free: jax.Array, active: jax.Array, cores: jax.Array,
     ``strict=True`` is Lend's ``>`` (scheduler.go:197) — the reference is
     deliberately inconsistent here and we preserve both. The gpu axis (a
     3-dim extension with no reference analogue) is always ``>=`` so that
-    gpu-less nodes stay feasible for gpu-less jobs in both modes.
+    gpu-less nodes stay feasible for gpu-less jobs in both modes; it is
+    only present when ``free`` carries 3 resources (SimConfig.n_res).
     """
     if strict:
         ok = jnp.logical_and(free[:, CORES] > cores, free[:, MEM] > mem)
     else:
         ok = jnp.logical_and(free[:, CORES] >= cores, free[:, MEM] >= mem)
-    ok = jnp.logical_and(ok, free[:, GPU] >= gpu)
+    if free.shape[-1] > GPU:
+        ok = jnp.logical_and(ok, free[:, GPU] >= gpu)
+    else:
+        # narrowed axis (n_res=2) == zero gpu capacity everywhere: a job
+        # that demands gpu must fail closed, not silently place
+        ok = jnp.logical_and(ok, jnp.asarray(gpu, jnp.int32) <= 0)
     return jnp.logical_and(ok, active)
 
 
@@ -55,9 +61,10 @@ def can_lend(free: jax.Array, active: jax.Array, job: JobRec) -> jax.Array:
 
 def occupy(free: jax.Array, node: jax.Array, job: JobRec, do: jax.Array) -> jax.Array:
     """Subtract job resources from ``free[node]`` when ``do``. (RunJob's
-    decrement half, cluster.go:144-148.)"""
-    idx = jnp.where(do, node, 0)
-    return free.at[idx, :].add(jnp.where(do, -job.res, 0))
+    decrement half, cluster.go:144-148.) One-hot select, not scatter."""
+    res = job.res[..., : free.shape[-1]]
+    hot = jnp.logical_and(jnp.arange(free.shape[0], dtype=jnp.int32) == node, do)
+    return free - hot[:, None] * res
 
 
 def best_fit_decreasing_order(q_cores: jax.Array, q_mem: jax.Array, valid: jax.Array) -> jax.Array:

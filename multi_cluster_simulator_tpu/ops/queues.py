@@ -168,10 +168,11 @@ def head(q: JobQueue) -> JobRec:
 
 
 def push_back(q: JobQueue, job: JobRec, do: jax.Array) -> JobQueue:
-    """Append one job if ``do`` (and capacity allows)."""
+    """Append one job if ``do`` (and capacity allows). One-hot select, not
+    scatter — scatters serialize on TPU and this is per-tick hot."""
     ok = jnp.logical_and(do, q.count < q.capacity)
-    idx = jnp.clip(q.count, 0, q.capacity - 1)
-    data = q.data.at[idx].set(jnp.where(ok, job.vec, q.data[idx]))
+    hot = jnp.logical_and(jnp.arange(q.capacity, dtype=jnp.int32) == q.count, ok)
+    data = jnp.where(hot[:, None], job.vec, q.data)
     return q.replace(data=data, count=q.count + ok.astype(jnp.int32))
 
 
@@ -189,8 +190,20 @@ def push_many(q: JobQueue, jobs: JobQueue, take: jax.Array,
                                                          stable=True)]
     dst = q.count + jnp.arange(jobs.capacity, dtype=jnp.int32)  # k-th taken row
     ok = jnp.logical_and(jnp.arange(jobs.capacity) < n_take, dst < q.capacity)
-    dst = jnp.where(ok, dst, q.capacity)  # out-of-range writes are dropped
-    data = q.data.at[dst].set(src, mode="drop")
+    if prefix and jobs.capacity <= 128:
+        # per-tick hot path (arrival ingest): scatter as a one-hot
+        # contraction — scatters serialize on TPU. O(cap x Qj), so only for
+        # small source batches; the borrow path (source capacity == total
+        # clusters) keeps the scatter below.
+        hot = jnp.logical_and(
+            dst[None, :] == jnp.arange(q.capacity, dtype=jnp.int32)[:, None],
+            ok[None, :])  # [cap, Qj]
+        written = jnp.any(hot, axis=1)
+        data = jnp.where(written[:, None],
+                         hot.astype(src.dtype) @ src, q.data)
+    else:
+        dst = jnp.where(ok, dst, q.capacity)  # out-of-range writes dropped
+        data = q.data.at[dst].set(src, mode="drop")
     added = jnp.minimum(n_take, q.capacity - q.count)
     return q.replace(data=data, count=q.count + added)
 

@@ -90,12 +90,17 @@ def row_from_job(job: JobRec, node, t) -> jax.Array:
 
 
 def start(rs: RunningSet, job: JobRec, node: jax.Array, t: jax.Array, do: jax.Array) -> RunningSet:
-    """Occupy the first free slot with a newly placed job (end = t + dur)."""
+    """Occupy the first free slot with a newly placed job (end = t + dur).
+
+    The slot write is a one-hot select, not a scatter — scatters serialize
+    on TPU and this runs once per placement-sweep step."""
     slot = jnp.argmin(rs.active).astype(jnp.int32)  # first inactive slot
     ok = jnp.logical_and(do, jnp.logical_not(rs.active[slot]))
     row = row_from_job(job, node, t)
-    data = rs.data.at[slot].set(jnp.where(ok, row, rs.data[slot]))
-    active = rs.active.at[slot].set(jnp.where(ok, True, rs.active[slot]))
+    hot = jnp.logical_and(
+        jnp.arange(rs.capacity, dtype=jnp.int32) == slot, ok)  # [S]
+    data = jnp.where(hot[:, None], row, rs.data)
+    active = jnp.logical_or(rs.active, hot)
     return RunningSet(data=data, active=active)
 
 
@@ -109,8 +114,10 @@ def release(rs: RunningSet, free: jax.Array, t: jax.Array):
     done = jnp.logical_and(rs.active, rs.end_t <= t)
     n_nodes = free.shape[0]
     node_idx = jnp.clip(rs.node, 0, n_nodes - 1)
-    back = jnp.where(done[:, None], rs.data[:, RCORES:RGPU + 1], 0)
-    free = free.at[node_idx].add(back)
+    back = jnp.where(done[:, None], rs.data[:, RCORES:RCORES + free.shape[-1]], 0)
+    # scatter-add as a one-hot contraction (scatters serialize on TPU)
+    hot = (node_idx[:, None] == jnp.arange(n_nodes, dtype=jnp.int32)[None, :])
+    free = free + jnp.einsum("sn,sr->nr", hot.astype(back.dtype), back)
     rs = RunningSet(
         data=jnp.where(done[:, None], _INVALID_ROW, rs.data),
         active=jnp.logical_and(rs.active, jnp.logical_not(done)))
