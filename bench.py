@@ -92,9 +92,15 @@ def bench_headline(quick=False):
     n_ticks = horizon_ms // cfg.tick_ms + 70  # drain tail
     out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks,
                                          use_mesh=True)
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+
     placed = int(np.asarray(out.placed_total).sum())
     total = C * jobs_per
     assert placed >= 0.99 * total, f"only {placed}/{total} jobs placed"
+    drops = total_drops(out)
+    assert all(v == 0 for v in drops.values()), (
+        f"headline static bounds bound ({drops}) — results would diverge "
+        "from the unbounded Go semantics; resize the config")
     jobs_per_sec = placed / wall_s
     return {
         "metric": "sim_jobs_per_sec_1M_jobs_4k_clusters",
@@ -103,7 +109,7 @@ def bench_headline(quick=False):
         "vs_baseline": round(jobs_per_sec / (1_000_000 / 60.0), 3),
         "detail": {"jobs": placed, "clusters": C, "wall_s": round(wall_s, 3),
                    "compile_s": round(compile_s, 1), "ticks": n_ticks,
-                   "sim_horizon_s": n_ticks,
+                   "sim_horizon_s": n_ticks, "drops": drops,
                    "speedup_vs_wallclock_reference": round(n_ticks / wall_s, 1)},
     }
 

@@ -56,7 +56,8 @@ from multi_cluster_simulator_tpu.ops import runset as R
 _STATE_AXES = SimState(
     t=None, node_cap=0, node_free=0, node_active=0, node_expire=0,
     l0=0, l1=0, ready=0, wait=0, lent=0, borrowed=0, run=0, arr_ptr=0,
-    wait_total=0, wait_jobs=0, jobs_in_queue=0, placed_total=0, trader=0, trace=0,
+    wait_total=0, wait_jobs=0, jobs_in_queue=0, placed_total=0, drops=0,
+    trader=0, trace=0,
 )
 _ARR_AXES = Arrivals(t=0, id=0, cores=0, mem=0, gpu=0, dur=0, n=0)
 
@@ -103,7 +104,12 @@ def _attempt(s: SimState, job: Q.JobRec, t, do, src, record_trace: bool):
     free = P.occupy(s.node_free, node, job, success)
     run = R.start(s.run, job, node, t, success)
     trace = _trace_append(s.trace, success, t, job.id, node, src) if record_trace else s.trace
-    s = s.replace(node_free=free, run=run, trace=trace,
+    # a feasible placement refused only by a full RunningSet is a divergence
+    # from Go (which has no such bound) — count it (SimState.drops)
+    run_full = jnp.logical_and(jnp.logical_and(do, node >= 0),
+                               jnp.logical_not(has_slot))
+    drops = s.drops.replace(run_full=s.drops.run_full + run_full.astype(jnp.int32))
+    s = s.replace(node_free=free, run=run, trace=trace, drops=drops,
                   placed_total=s.placed_total + success.astype(jnp.int32))
     return s, success
 
@@ -156,7 +162,8 @@ def _pack_returns(run, done, M: int):
     order = jnp.argsort(jnp.logical_not(is_ret), axis=1, stable=True)[:, :M]
     take = jnp.take_along_axis(is_ret, order, axis=1)  # [C_loc, M]
     rows = jnp.take_along_axis(run.data, order[..., None], axis=1)  # [C_loc, M, RF]
-    return rows, take
+    dropped = jnp.sum(is_ret, axis=1) - jnp.sum(take, axis=1)  # beyond M
+    return rows, take, dropped.astype(jnp.int32)
 
 
 def _deliver_returns(state: SimState, rows, take, ex) -> SimState:
@@ -232,6 +239,9 @@ def _ingest_local(s: SimState, arr_rows: jax.Array, arr_n: jax.Array, t,
     rows = hot.astype(arr_rows.dtype) @ arr_rows  # [K, NF]
     valid = jnp.arange(K, dtype=jnp.int32) < n
     batch = Q.JobQueue(data=rows, count=n)
+    tgt = s.l0 if to_delay else s.ready
+    dropped = Q.push_many_dropped(tgt, valid)
+    s = s.replace(drops=s.drops.replace(queue=s.drops.queue + dropped))
     if to_delay:
         q = Q.push_many(s.l0, batch, valid, prefix=True)
         s = s.replace(l0=q, wait_jobs=s.wait_jobs + n, jobs_in_queue=s.jobs_in_queue + n)
@@ -302,6 +312,8 @@ def _delay_local(s: SimState, t, cfg: SimConfig):
     s = s.replace(
         l0=Q.pop_front(s.l0, jnp.logical_or(success, promote)),
         l1=Q.push_back(s.l1, job, promote),
+        drops=s.drops.replace(
+            queue=s.drops.queue + Q.push_back_dropped(s.l1, promote)),
     )
     return s
 
@@ -379,7 +391,9 @@ def _fifo_local(s: SimState, t, cfg: SimConfig):
     s, _, _, n_taken, fail_job, any_fail = jax.lax.while_loop(dcond, dstep, init)
     # the drain consumes a strict prefix of the ready queue
     s = s.replace(ready=Q.pop_front_n(s.ready, n_taken),
-                  wait=Q.push_back(s.wait, fail_job, any_fail))
+                  wait=Q.push_back(s.wait, fail_job, any_fail),
+                  drops=s.drops.replace(
+                      queue=s.drops.queue + Q.push_back_dropped(s.wait, any_fail)))
 
     # ---- wait-head attempt (the branch at scheduler.go:219-252) ----
     process_w = s.wait.count > 0
@@ -439,10 +453,11 @@ def _borrow_match(state: SimState, want, jobs: Q.JobRec, cfg: SimConfig, ex) -> 
     owned = jobs.with_(owner=gidx)
 
     def borrower_update(s_wait, s_borrowed, job, m):
-        return Q.pop_front(s_wait, m), Q.push_back(s_borrowed, job, m)
+        return (Q.pop_front(s_wait, m), Q.push_back(s_borrowed, job, m),
+                Q.push_back_dropped(s_borrowed, m))
 
-    wait, borrowed = jax.vmap(borrower_update)(state.wait, state.borrowed,
-                                               owned, matched_loc)
+    wait, borrowed, bdrop = jax.vmap(borrower_update)(
+        state.wait, state.borrowed, owned, matched_loc)
 
     # Lender side (local): append to LentQueue (server.go:94-107). Several
     # borrowers may win the same lender in one tick (the Go handler takes
@@ -452,10 +467,12 @@ def _borrow_match(state: SimState, want, jobs: Q.JobRec, cfg: SimConfig, ex) -> 
 
     def lender_update(lent_q, gl):
         take = jnp.logical_and(matched_g, winner == gl)
-        return Q.push_many(lent_q, send_rows, take)
+        return Q.push_many(lent_q, send_rows, take), Q.push_many_dropped(lent_q, take)
 
-    lent = jax.vmap(lender_update)(state.lent, gidx)
-    return state.replace(wait=wait, borrowed=borrowed, lent=lent)
+    lent, ldrop = jax.vmap(lender_update)(state.lent, gidx)
+    return state.replace(wait=wait, borrowed=borrowed, lent=lent,
+                         drops=state.drops.replace(
+                             queue=state.drops.queue + bdrop + ldrop))
 
 
 # --------------------------------------------------------------------------
@@ -530,7 +547,10 @@ class Engine:
                              out_axes=(_STATE_AXES, 0))(state, t)
         state = st2
         if cfg.borrowing or emit_io:
-            ret_rows, ret_valid = _pack_returns(run_before, done, cfg.max_msgs)
+            ret_rows, ret_valid, ret_dropped = _pack_returns(
+                run_before, done, cfg.max_msgs)
+            state = state.replace(drops=state.drops.replace(
+                msgs=state.drops.msgs + ret_dropped))
         else:
             C = done.shape[0]
             ret_rows = jnp.zeros((C, cfg.max_msgs, R.RF), jnp.int32)

@@ -283,27 +283,39 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
     has_winner = winner < INF
 
     # ---- apply: seller side — occupy carved amounts as Foreign placeholder
-    # jobs for the contract duration (cluster.go:116) ----
+    # jobs for the contract duration (cluster.go:116). The node_free
+    # decrement is gated on the placeholder row actually inserting: without
+    # a RunningSet slot there is nothing to release the resources later, so
+    # decrementing would leak them permanently (round-2 VERDICT weak #3);
+    # the skipped occupation is surfaced in drops.carve ----
     def seller_apply(free, run, amts, ccon, win):
-        free = free - jnp.where(win, amts, 0)
-
-        def add_placeholder(rn, n):
+        def add_placeholder(carry, n):
+            rn, fr, miss = carry
             occ = jnp.logical_and(win, jnp.any(amts[n] > 0))
             slot = jnp.argmin(rn.active).astype(jnp.int32)
             ok = jnp.logical_and(occ, jnp.logical_not(rn.active[slot]))
             row = R.make_row(t + ccon.time_ms, n, amts[n, CORES], amts[n, MEM],
                              amts[n, GPU], PLACEHOLDER_ID, FOREIGN,
                              ccon.time_ms, t)
-            return R.RunningSet(
-                data=rn.data.at[slot].set(jnp.where(ok, row, rn.data[slot])),
-                active=rn.active.at[slot].set(
-                    jnp.where(ok, True, rn.active[slot]))), None
+            hot = jnp.logical_and(
+                jnp.arange(rn.capacity, dtype=jnp.int32) == slot, ok)
+            rn = R.RunningSet(data=jnp.where(hot[:, None], row, rn.data),
+                              active=jnp.logical_or(rn.active, hot))
+            nhot = jnp.logical_and(
+                jnp.arange(fr.shape[0], dtype=jnp.int32) == n, ok)
+            fr = fr - nhot[:, None] * amts[n]
+            miss = miss + jnp.logical_and(
+                occ, jnp.logical_not(ok)).astype(jnp.int32)
+            return (rn, fr, miss), None
 
         N = free.shape[0]
-        run, _ = jax.lax.scan(add_placeholder, run, jnp.arange(N, dtype=jnp.int32))
-        return free, run
+        (run, free, miss), _ = jax.lax.scan(
+            add_placeholder, (run, free, jnp.int32(0)),
+            jnp.arange(N, dtype=jnp.int32))
+        return free, run, miss
 
-    free, run = jax.vmap(seller_apply)(state.node_free, state.run, amounts, csel, win_sell)
+    free, run, carve_miss = jax.vmap(seller_apply)(
+        state.node_free, state.run, amounts, csel, win_sell)
 
     # ---- apply: buyer side — AddVirtualNode (cluster.go:65-85): the
     # NodeObject echoes the contract's cores/mem (trader_server.go:58) ----
@@ -322,9 +334,10 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
         active = active.at[slot].set(jnp.where(ok, True, active[slot]))
         exp_val = (t + ccon.time_ms) if mcfg.expire_virtual_nodes else R.NEVER
         expire = expire.at[slot].set(jnp.where(ok, exp_val, expire[slot]))
-        return cap, free_b, active, expire
+        vmiss = jnp.logical_and(got, jnp.logical_not(jnp.any(slot_free)))
+        return cap, free_b, active, expire, vmiss.astype(jnp.int32)
 
-    cap, free, active, expire = jax.vmap(buyer_apply)(
+    cap, free, active, expire, vslot_miss = jax.vmap(buyer_apply)(
         state.node_cap, free, state.node_active, state.node_expire, wcon, got_node)
 
     # ---- cooldowns (the 4 min / 2 min sleeps, trader.go:296-302) ----
@@ -336,6 +349,8 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
     return state.replace(
         node_cap=cap, node_free=free, node_active=active, node_expire=expire,
         run=run,
+        drops=state.drops.replace(vslot=state.drops.vslot + vslot_miss,
+                                  carve=state.drops.carve + carve_miss),
         trader=tr.replace(seller_locked_until=new_lock, cooldown_until=cooldown,
                           spent=spent,
                           next_contract_id=tr.next_contract_id

@@ -208,6 +208,17 @@ def push_many(q: JobQueue, jobs: JobQueue, take: jax.Array,
     return q.replace(data=data, count=q.count + added)
 
 
+def push_back_dropped(q: JobQueue, do: jax.Array) -> jax.Array:
+    """0/1: whether push_back(q, ., do) would overflow (SimState.drops)."""
+    return jnp.logical_and(do, q.count >= q.capacity).astype(jnp.int32)
+
+
+def push_many_dropped(q: JobQueue, take: jax.Array) -> jax.Array:
+    """How many of ``take`` push_many(q, ., take) would overflow."""
+    n_take = jnp.sum(take).astype(jnp.int32)
+    return jnp.maximum(n_take - (q.capacity - q.count), 0)
+
+
 def pop_front(q: JobQueue, do: jax.Array) -> JobQueue:
     """Drop the head job if ``do`` (FIFO pop), shifting everything left."""
     shifted = jnp.roll(q.data, -1, axis=0).at[-1].set(_INVALID_ROW)

@@ -31,7 +31,7 @@ def _state_specs(axis: str):
         node_expire=shard, l0=shard, l1=shard, ready=shard, wait=shard,
         lent=shard, borrowed=shard, run=shard, arr_ptr=shard,
         wait_total=shard, wait_jobs=shard, jobs_in_queue=shard,
-        placed_total=shard, trader=shard, trace=shard)
+        placed_total=shard, drops=shard, trader=shard, trace=shard)
 
 
 def _arr_specs(axis: str):

@@ -1,3 +1,6 @@
-from multi_cluster_simulator_tpu.utils.trace import extract_trace
+from multi_cluster_simulator_tpu.utils.trace import (
+    assert_no_drops, check_conservation, extract_trace, total_drops,
+)
 
-__all__ = ["extract_trace"]
+__all__ = ["extract_trace", "check_conservation", "total_drops",
+           "assert_no_drops"]

@@ -34,7 +34,7 @@ def oracle_trace_per_cluster(oracle, n_clusters: int) -> list[list[tuple[int, in
 
 def check_conservation(state: SimState) -> None:
     """Invariant: free + sum(running on node) == capacity for active nodes,
-    and 0 <= free <= cap."""
+    and 0 <= free <= cap. Honors the configured resource width (n_res)."""
     free = np.asarray(state.node_free)
     cap = np.asarray(state.node_cap)
     active = np.asarray(state.node_active)
@@ -44,7 +44,7 @@ def check_conservation(state: SimState) -> None:
     r_mem = np.asarray(run.mem)
     r_gpu = np.asarray(run.gpu)
     r_act = np.asarray(run.active)
-    C, N, _ = free.shape
+    C, N, n_res = free.shape
     used = np.zeros((C, N, 3), np.int64)
     for c in range(C):
         for s in range(r_node.shape[1]):
@@ -53,6 +53,19 @@ def check_conservation(state: SimState) -> None:
                 used[c, r_node[c, s], 1] += r_mem[c, s]
                 used[c, r_node[c, s], 2] += r_gpu[c, s]
     assert (free >= 0).all(), "negative free resources"
-    recon = free + used
+    recon = free + used[..., :n_res]
     mism = (recon != cap) & active[..., None]
     assert not mism.any(), f"conservation violated at {np.argwhere(mism)[:5]}"
+
+
+def total_drops(state: SimState) -> dict:
+    """Summed SimState.drops counters — every one should be zero on a
+    correctly sized config (see core/state.py Drops)."""
+    d = state.drops
+    return {k: int(np.asarray(getattr(d, k)).sum())
+            for k in ("queue", "msgs", "run_full", "vslot", "carve")}
+
+
+def assert_no_drops(state: SimState) -> None:
+    drops = total_drops(state)
+    assert all(v == 0 for v in drops.values()), f"static bounds bound: {drops}"

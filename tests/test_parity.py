@@ -13,7 +13,7 @@ from multi_cluster_simulator_tpu.core.spec import uniform_cluster
 from multi_cluster_simulator_tpu.core.state import init_state
 from multi_cluster_simulator_tpu.oracle.go_semantics import Oracle
 from multi_cluster_simulator_tpu.utils.trace import (
-    check_conservation, extract_trace, oracle_trace_per_cluster,
+    assert_no_drops, check_conservation, extract_trace, oracle_trace_per_cluster,
 )
 from tests.conftest import make_arrivals
 
@@ -28,6 +28,8 @@ def run_both(cfg: SimConfig, specs, n_ticks: int, seed: int = 9):
 
 
 def assert_traces_equal(state, oracle, n_clusters):
+    # parity is only claimed when no static bound bound (Go is unbounded)
+    assert_no_drops(state)
     got = extract_trace(state)
     want = oracle_trace_per_cluster(oracle, n_clusters)
     for c in range(n_clusters):
