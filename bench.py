@@ -57,17 +57,26 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200):
         step = lambda s, n: jfn(s, arrivals, n)
 
     def run(s):
+        if not cfg.record_metrics:
+            for n in chunks:
+                s = step(s, n)
+            return jax.block_until_ready(s), None
+        parts = []
         for n in chunks:
-            s = step(s, n)
-        return jax.block_until_ready(s)
+            s, ser = step(s, n)
+            parts.append(ser)
+        s = jax.block_until_ready(s)
+        series = jax.tree.map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *parts)
+        return s, series
 
     t0 = time.time()
-    out = run(state)
+    out, series = run(state)
     compile_s = time.time() - t0
     t0 = time.time()
-    out = run(state)
+    out, series = run(state)
     wall_s = time.time() - t0
-    return out, wall_s, compile_s
+    return out, wall_s, compile_s, series
 
 
 def bench_headline(quick=False):
@@ -90,7 +99,7 @@ def bench_headline(quick=False):
     arrivals = uniform_stream(C, jobs_per, horizon_ms, max_cores=8,
                               max_mem=6_000, max_dur_ms=60_000, seed=9)
     n_ticks = horizon_ms // cfg.tick_ms + 70  # drain tail
-    out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks,
+    out, wall_s, compile_s, _ = _engine_run(cfg, specs, arrivals, n_ticks,
                                          use_mesh=True)
     from multi_cluster_simulator_tpu.utils.trace import total_drops
 
@@ -115,25 +124,40 @@ def bench_headline(quick=False):
 
 
 def bench_fifo_small():
-    """Config 1: FIFO, single cluster, cluster_small, reference workload."""
+    """Config 1: FIFO, single cluster, cluster_small, reference workload.
+    Runs with record_metrics=True and exports the per-tick jobs_in_queue /
+    avg-wait series (decimated to the reference's 5 s recording cadence,
+    pkg/scheduler/metrics.go:19-30) to bench_metrics.json."""
     from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
     from multi_cluster_simulator_tpu.core.spec import uniform_cluster
     from multi_cluster_simulator_tpu.workload import generate_arrivals
 
     cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=128,
-                    max_running=512, max_arrivals=2048, max_nodes=5, n_res=2)
+                    max_running=512, max_arrivals=2048, max_nodes=5, n_res=2,
+                    record_metrics=True)
     n_ticks = 3600
     arrivals = generate_arrivals(cfg.workload, 1, cfg.max_arrivals,
                                  n_ticks * 1000, 32, 24_000, seed=9)
-    out, wall_s, compile_s = _engine_run(cfg, [uniform_cluster(1, 5)],
-                                         arrivals, n_ticks)
+    out, wall_s, compile_s, series = _engine_run(cfg, [uniform_cluster(1, 5)],
+                                                 arrivals, n_ticks)
+    stride = 5_000 // cfg.tick_ms  # the reference records every 5 s
+    with open("bench_metrics.json", "w") as f:
+        json.dump({
+            "t_ms": series.t[::stride].tolist(),
+            "jobs_in_queue": series.jobs_in_queue[::stride, 0].tolist(),
+            "avg_wait_ms": [round(float(x), 2)
+                            for x in series.avg_wait_ms[::stride, 0]],
+        }, f)
     return {
         "metric": "fifo_cluster_small_ticks_per_sec",
         "value": round(n_ticks / wall_s, 1),
         "unit": "virtual-s/s",
         "vs_baseline": round(n_ticks / wall_s, 1),  # Go runs 1 virtual-s/s
         "detail": {"wall_s": round(wall_s, 3), "compile_s": round(compile_s, 1),
-                   "placed": int(np.asarray(out.placed_total).sum())},
+                   "placed": int(np.asarray(out.placed_total).sum()),
+                   "peak_jobs_in_queue": int(series.jobs_in_queue.max()),
+                   "final_avg_wait_ms": round(float(series.avg_wait_ms[-1, 0]), 1),
+                   "metrics_file": "bench_metrics.json"},
     }
 
 
@@ -153,7 +177,7 @@ def bench_fifo_two_trader():
     arrivals = generate_arrivals(cfg.workload, 2, cfg.max_arrivals,
                                  n_ticks * 1000, 32, 24_000, seed=9)
     specs = [uniform_cluster(1, 5), uniform_cluster(2, 10)]
-    out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks)
+    out, wall_s, compile_s, _ = _engine_run(cfg, specs, arrivals, n_ticks)
     return {
         "metric": "fifo_two_cluster_trader_ticks_per_sec",
         "value": round(n_ticks / wall_s, 1),
@@ -182,7 +206,7 @@ def bench_ffd64(quick=False):
     arrivals = uniform_stream(C, jobs_per, horizon_ms, max_cores=4,
                               max_mem=3_000, max_dur_ms=30_000, seed=3)
     n_ticks = horizon_ms // 1000 + 100
-    out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks,
+    out, wall_s, compile_s, _ = _engine_run(cfg, specs, arrivals, n_ticks,
                                          use_mesh=True)
     placed = int(np.asarray(out.placed_total).sum())
     assert placed >= 0.95 * C * jobs_per, f"only {placed}/{C * jobs_per} placed"
@@ -224,7 +248,7 @@ def bench_sinkhorn(quick=False):
                               max_mem=18_000, max_dur_ms=300_000, seed=7,
                               max_gpus=2, gpu_frac=0.1)
     n_ticks = horizon_ms // cfg.tick_ms + 100
-    out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks,
+    out, wall_s, compile_s, _ = _engine_run(cfg, specs, arrivals, n_ticks,
                                          use_mesh=True)
     placed = int(np.asarray(out.placed_total).sum())
     vnodes = int(np.asarray(out.node_active)[:, cfg.max_nodes:].sum())
@@ -259,7 +283,7 @@ def bench_borg4k(quick=False):
     arrivals = borg_like_stream(C, jobs_per, horizon_ms, max_cores=32,
                                 max_mem=24_000, seed=19)
     n_ticks = horizon_ms // 1000 + 100
-    out, wall_s, compile_s = _engine_run(cfg, specs, arrivals, n_ticks,
+    out, wall_s, compile_s, _ = _engine_run(cfg, specs, arrivals, n_ticks,
                                          use_mesh=True)
     placed = int(np.asarray(out.placed_total).sum())
     return {

@@ -74,6 +74,16 @@ class TraderConfig:
     # removes, pkg/scheduler/cluster.go:65-85), which the False default
     # reproduces.
     expire_virtual_nodes: bool = False
+    # Live-host-only knob (services/trader_host.py). When a request policy
+    # breaks while Level1 is empty, Go sizes a 0-core/0-MB contract and
+    # trades it anyway — the buyer attaches a zero-capacity virtual node
+    # that burns one of its finite virtual slots (trader.go:288-311 with an
+    # empty ProvideJobs stream). The live TraderService skips such contracts
+    # by default (with a log line); set False to reproduce Go's churn. The
+    # batch market (market/trader.py) and the oracle are bit-parity surfaces
+    # and always reproduce Go's zero-contract trades, ignoring this flag
+    # (MARKET.md §divergences).
+    skip_zero_contracts: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,7 +135,9 @@ class SimConfig:
     # --- instrumentation ---
     record_trace: bool = False  # record per-placement events
     max_trace_events: int = 1 << 16
-    record_metrics: bool = False  # per-tick metric outputs from scan
+    # When True, Engine.run returns (state, MetricSample series): per-tick
+    # jobs_in_queue + avg-wait stacked from the scan (metrics.go:11-31).
+    record_metrics: bool = False
 
     trader: TraderConfig = dataclasses.field(default_factory=TraderConfig)
     workload: WorkloadConfig = dataclasses.field(default_factory=WorkloadConfig)

@@ -604,14 +604,21 @@ class Engine:
         return state.replace(t=t), io
 
     # -- scan driver --
-    def run(self, state: SimState, arrivals: Arrivals, n_ticks: int) -> SimState:
+    def run(self, state: SimState, arrivals: Arrivals, n_ticks: int):
+        """Advance ``n_ticks``. Returns the final state — or, when
+        ``cfg.record_metrics`` is set, ``(state, MetricSample)`` with [T] /
+        [T, C] stacked per-tick series (the batch-engine form of RunMetrics'
+        recorder goroutine, pkg/scheduler/metrics.go:11-31; decimate to the
+        reference's 5 s cadence host-side with ``series[::5]``)."""
         packed = pack_arrivals(arrivals)  # once, outside the tick scan
+        record = self.cfg.record_metrics
 
         def body(s, _):
-            return self._tick(s, packed, emit_io=False)[0], None
+            s2 = self._tick(s, packed, emit_io=False)[0]
+            return s2, (st.metric_sample(s2) if record else None)
 
-        state, _ = jax.lax.scan(body, state, None, length=n_ticks)
-        return state
+        state, series = jax.lax.scan(body, state, None, length=n_ticks)
+        return (state, series) if record else state
 
     def run_jit(self):
         """A jitted (state, arrivals, n_ticks-static) -> state."""

@@ -129,6 +129,24 @@ def avg_wait_ms(s: SimState) -> jax.Array:
     return jnp.where(s.wait_jobs > 0, s.wait_total / jnp.maximum(s.wait_jobs, 1), 0.0)
 
 
+@struct.dataclass
+class MetricSample:
+    """One tick's metric readout — the tensor form of RunMetrics' 5 s
+    recorder (pkg/scheduler/metrics.go:11-31): the ``jobs_in_queue`` up/down
+    counter and the ``waitTime`` running average, per cluster. Stacked by
+    ``lax.scan`` into a [T]/[T, C] time-series when
+    ``SimConfig.record_metrics`` is set."""
+
+    t: jax.Array  # [] i32 virtual ms (tick timestamp)
+    jobs_in_queue: jax.Array  # [C] i32
+    avg_wait_ms: jax.Array  # [C] f32
+
+
+def metric_sample(s: SimState) -> MetricSample:
+    return MetricSample(t=s.t, jobs_in_queue=s.jobs_in_queue,
+                        avg_wait_ms=avg_wait_ms(s))
+
+
 def utilization(s: SimState) -> tuple[jax.Array, jax.Array]:
     """(core_util, mem_util) per cluster — GetResourceUtilization
     (cluster.go:46-63): used/total over active nodes."""

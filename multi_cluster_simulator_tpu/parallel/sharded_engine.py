@@ -66,16 +66,23 @@ class ShardedEngine:
 
     def run_fn(self, n_ticks: int):
         """A jitted (state, arrivals) -> state advancing n_ticks under
-        shard_map."""
+        shard_map (``(state, MetricSample)`` when cfg.record_metrics: the
+        [T, C] series stays cluster-sharded on its second axis)."""
         eng = self.engine
 
         def body(state, arrivals):
             return eng.run(state, arrivals, n_ticks)
 
+        out_specs = _state_specs(self.axis)
+        if self.cfg.record_metrics:
+            from multi_cluster_simulator_tpu.core.state import MetricSample
+            out_specs = (out_specs, MetricSample(
+                t=P(), jobs_in_queue=P(None, self.axis),
+                avg_wait_ms=P(None, self.axis)))
         mapped = jax.shard_map(
             body, mesh=self.mesh,
             in_specs=(_state_specs(self.axis), _arr_specs(self.axis)),
-            out_specs=_state_specs(self.axis),
+            out_specs=out_specs,
             check_vma=False)
         return jax.jit(mapped)
 

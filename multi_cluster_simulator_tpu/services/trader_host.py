@@ -154,6 +154,15 @@ class TraderService(Service):
                     contract = self._size_contract("small")
                 else:
                     continue
+                if (self.tcfg.skip_zero_contracts
+                        and contract.cores == 0 and contract.memory == 0):
+                    # Level1 was empty when the policy broke; trading this
+                    # would attach a zero-capacity virtual node at the buyer
+                    # (config.py skip_zero_contracts; divergence from
+                    # trader.go:288-311, documented in MARKET.md).
+                    self.logger.info("skipping zero-size contract "
+                                     "(empty Level1 backlog)")
+                    continue
                 won = self._trade(contract)
                 cooldown = (self.tcfg.cooldown_success_ms if won
                             else self.tcfg.cooldown_failure_ms)

@@ -1,0 +1,73 @@
+"""record_metrics: the per-tick metric series emitted from the scan must
+match an oracle recomputation tick-by-tick (the batch-engine form of the
+reference's RunMetrics recorder, pkg/scheduler/metrics.go:11-31)."""
+
+import numpy as np
+
+from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+from multi_cluster_simulator_tpu.core.engine import Engine
+from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.oracle.go_semantics import Oracle
+from tests.conftest import make_arrivals
+
+N_TICKS = 120
+
+
+def _run_with_series(cfg, specs, seed=9):
+    arrivals = make_arrivals(cfg, len(specs), horizon_ms=N_TICKS * cfg.tick_ms,
+                             seed=seed)
+    eng = Engine(cfg)
+    state, series = eng.run_jit()(init_state(cfg, specs), arrivals, N_TICKS)
+    return state, series, arrivals
+
+
+def _oracle_series(cfg, specs, arrivals):
+    """Step the oracle one tick at a time, reading the same counters the
+    engine samples after each tick."""
+    o = Oracle(cfg, list(specs), arrivals)
+    jq, aw = [], []
+    for _ in range(N_TICKS):
+        o.tick()
+        jq.append([cl.jobs_in_queue for cl in o.clusters])
+        aw.append([o.avg_wait(c) for c in range(len(o.clusters))])
+    return np.asarray(jq, np.int32), np.asarray(aw, np.float32)
+
+
+def test_metrics_series_matches_oracle_delay():
+    cfg = SimConfig(policy=PolicyKind.DELAY, record_metrics=True,
+                    queue_capacity=64, max_running=512, max_arrivals=2048,
+                    max_nodes=5)
+    specs = [uniform_cluster(1, 5), uniform_cluster(2, 5)]
+    state, series, arrivals = _run_with_series(cfg, specs)
+
+    jq, aw = _oracle_series(cfg, specs, arrivals)
+    got_jq = np.asarray(series.jobs_in_queue)
+    got_aw = np.asarray(series.avg_wait_ms)
+    assert got_jq.shape == (N_TICKS, 2)
+    np.testing.assert_array_equal(got_jq, jq)
+    np.testing.assert_allclose(got_aw, aw, rtol=1e-6)
+    # timestamps are the tick clock
+    np.testing.assert_array_equal(
+        np.asarray(series.t),
+        np.arange(1, N_TICKS + 1, dtype=np.int32) * cfg.tick_ms)
+
+
+def test_metrics_series_final_sample_equals_state():
+    cfg = SimConfig(policy=PolicyKind.FIFO, record_metrics=True,
+                    queue_capacity=64, max_running=512, max_arrivals=2048,
+                    max_nodes=5)
+    specs = [uniform_cluster(1, 5)]
+    state, series, _ = _run_with_series(cfg, specs)
+    np.testing.assert_array_equal(np.asarray(series.jobs_in_queue[-1]),
+                                  np.asarray(state.jobs_in_queue))
+    assert int(series.t[-1]) == int(state.t)
+
+
+def test_metrics_off_returns_bare_state():
+    cfg = SimConfig(policy=PolicyKind.DELAY, queue_capacity=64,
+                    max_running=512, max_arrivals=2048, max_nodes=5)
+    specs = [uniform_cluster(1, 5)]
+    arrivals = make_arrivals(cfg, 1, horizon_ms=N_TICKS * 1000)
+    out = Engine(cfg).run_jit()(init_state(cfg, specs), arrivals, N_TICKS)
+    assert not isinstance(out, tuple)
