@@ -22,6 +22,7 @@ from multi_cluster_simulator_tpu.config import SimConfig, TraderConfig, Workload
 from multi_cluster_simulator_tpu.core.spec import ClusterSpec, NodeSpec, load_cluster_json
 from multi_cluster_simulator_tpu.core.state import SimState, init_state
 from multi_cluster_simulator_tpu.core.engine import Engine
+from multi_cluster_simulator_tpu.core.checkpoint import load_state, save_state
 
 __version__ = "0.1.0"
 
@@ -35,4 +36,6 @@ __all__ = [
     "SimState",
     "init_state",
     "Engine",
+    "save_state",
+    "load_state",
 ]

@@ -1,5 +1,7 @@
 from multi_cluster_simulator_tpu.core.spec import ClusterSpec, NodeSpec, load_cluster_json
 from multi_cluster_simulator_tpu.core.state import SimState, init_state
 from multi_cluster_simulator_tpu.core.engine import Engine
+from multi_cluster_simulator_tpu.core.checkpoint import load_state, peek_checkpoint_t, save_state
 
-__all__ = ["ClusterSpec", "NodeSpec", "load_cluster_json", "SimState", "init_state", "Engine"]
+__all__ = ["ClusterSpec", "NodeSpec", "load_cluster_json", "SimState", "init_state",
+           "Engine", "save_state", "load_state", "peek_checkpoint_t"]

@@ -609,7 +609,8 @@ class Engine:
         ``cfg.record_metrics`` is set, ``(state, MetricSample)`` with [T] /
         [T, C] stacked per-tick series (the batch-engine form of RunMetrics'
         recorder goroutine, pkg/scheduler/metrics.go:11-31; decimate to the
-        reference's 5 s cadence host-side with ``series[::5]``)."""
+        reference's 5 s marks host-side, e.g.
+        ``jax.tree.map(lambda a: a[4::5], series)`` — sample 0 is t=1 s)."""
         packed = pack_arrivals(arrivals)  # once, outside the tick scan
         record = self.cfg.record_metrics
 

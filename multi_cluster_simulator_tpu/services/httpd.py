@@ -18,6 +18,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from multi_cluster_simulator_tpu.services import telemetry
+
 # A handler takes (body_bytes, headers_dict) and returns
 # (status_code, body_bytes_or_None). Content type is JSON unless overridden.
 Route = Callable[[bytes, dict], tuple[int, Optional[bytes]]]
@@ -29,11 +31,18 @@ class RoutedHTTPServer:
     ``port=0`` binds an ephemeral port (the reference picks random ports in
     [1025, 49151), cmd/scheduler/main.go:62-63 — the OS-assigned ephemeral
     port is the same capability without the collision risk).
+
+    When a ``tracer`` is supplied, every dispatched request runs inside a
+    server span whose parent is read from the ``TRACE_HEADER`` request
+    header — the otelhttp.NewHandler middleware the reference wraps every
+    service mux with (internal/service/service.go:37-38).
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, logger=None):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, logger=None,
+                 tracer: Optional[telemetry.Tracer] = None):
         self.routes: dict[tuple[str, str], Route] = {}
         self.logger = logger
+        self.tracer = tracer
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -49,8 +58,16 @@ class RoutedHTTPServer:
                     return
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n) if n else b""
+                headers = dict(self.headers)
                 try:
-                    status, out = fn(body, dict(self.headers))
+                    if outer.tracer is not None:
+                        parent = headers.get(telemetry.TRACE_HEADER)
+                        with outer.tracer.start_span(
+                                f"{method} {path}", parent=parent,
+                                kind="server"):
+                            status, out = fn(body, headers)
+                    else:
+                        status, out = fn(body, headers)
                 except Exception as e:  # route bug -> 500, keep serving
                     if outer.logger is not None:
                         outer.logger.error("handler %s %s failed: %r",
@@ -102,29 +119,42 @@ class RoutedHTTPServer:
 # client helpers
 # ---------------------------------------------------------------------------
 
+def _trace_headers(headers: dict) -> dict:
+    """Inject the active span context, if any — otelhttp.NewTransport
+    (pkg/scheduler/server.go:47, pkg/client/server.go:57)."""
+    ctx = telemetry.current_context()
+    if ctx is not None:
+        headers = {**headers, telemetry.TRACE_HEADER: ctx}
+    return headers
+
+
 def post_json(url: str, obj, timeout: float = 5.0) -> tuple[int, bytes]:
     """http.Post(url, "application/json", body) — returns (status, body).
     Transport errors surface as status 0."""
     data = json.dumps(obj).encode()
-    req = urllib.request.Request(url, data=data, method="POST",
-                                 headers={"Content-Type": "application/json"})
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers=_trace_headers({"Content-Type": "application/json"}))
     return _do(req, timeout)
 
 
 def post_bytes(url: str, data: bytes, content_type: str = "text/plain",
                timeout: float = 5.0) -> tuple[int, bytes]:
-    req = urllib.request.Request(url, data=data, method="POST",
-                                 headers={"Content-Type": content_type})
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers=_trace_headers({"Content-Type": content_type}))
     return _do(req, timeout)
 
 
 def get(url: str, timeout: float = 5.0) -> tuple[int, bytes]:
-    return _do(urllib.request.Request(url, method="GET"), timeout)
+    return _do(urllib.request.Request(url, method="GET",
+                                      headers=_trace_headers({})), timeout)
 
 
 def delete(url: str, data: bytes = b"", timeout: float = 5.0) -> tuple[int, bytes]:
-    req = urllib.request.Request(url, data=data, method="DELETE",
-                                 headers={"Content-Type": "text/plain"})
+    req = urllib.request.Request(
+        url, data=data, method="DELETE",
+        headers=_trace_headers({"Content-Type": "text/plain"}))
     return _do(req, timeout)
 
 

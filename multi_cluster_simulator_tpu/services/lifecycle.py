@@ -35,7 +35,8 @@ class Service:
         self.tracer = telemetry.Tracer(name, path=spans_path)
         self.meter = telemetry.Meter(name, export_path=metrics_path,
                                      export_period_s=5.0 / speed)
-        self.httpd = httpd.RoutedHTTPServer(host, port, logger=self.logger)
+        self.httpd = httpd.RoutedHTTPServer(host, port, logger=self.logger,
+                                            tracer=self.tracer)
         self.url = self.httpd.url
         # What gets registered as ServiceURL. Defaults to the HTTP server;
         # the trader advertises its gRPC address instead (the reference

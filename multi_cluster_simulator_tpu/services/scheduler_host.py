@@ -31,14 +31,16 @@ from typing import Optional
 import jax
 import numpy as np
 
-from multi_cluster_simulator_tpu.config import RETURN_ATTEMPTS, SimConfig
+from multi_cluster_simulator_tpu.config import (
+    RETURN_ATTEMPTS, PolicyKind, SimConfig,
+)
 from multi_cluster_simulator_tpu.core import state as st
 from multi_cluster_simulator_tpu.core.engine import Engine
 from multi_cluster_simulator_tpu.core.spec import ClusterSpec
 from multi_cluster_simulator_tpu.core.state import Arrivals, init_state
 from multi_cluster_simulator_tpu.ops import queues as Q
 from multi_cluster_simulator_tpu.ops import runset as R
-from multi_cluster_simulator_tpu.services import host_ops, httpd
+from multi_cluster_simulator_tpu.services import host_ops, httpd, telemetry
 from multi_cluster_simulator_tpu.services.lifecycle import Service
 from multi_cluster_simulator_tpu.services.registry import SERVICE_SCHEDULER
 
@@ -112,28 +114,34 @@ class SchedulerService(Service):
                          lambda b, h: (200, self.meter.render_prometheus().encode()))
 
     def _handle_submit_fifo(self, body: bytes, headers: dict):
-        """POST / — FIFO-path submit to the ReadyQueue (server.go:23-51);
-        echoes a GET <Referer>/jobAdded acknowledgement."""
+        """POST / — submit to the ReadyQueue (server.go:23-51) *regardless
+        of the configured algorithm*, exactly as the reference's handler
+        does; echoes a GET <Referer>/jobAdded acknowledgement."""
         try:
             job = job_from_json(json.loads(body))
         except ValueError:
             return 400, None
-        self._stage_arrival(job)
+        # manual job-receipt span nested under the middleware's server span
+        # (the reference opens one at the top of the handler, server.go:24)
+        with self.tracer.start_span("receive_job", job_id=job[0]):
+            self._stage_arrival(job, delay=False)
         referer = headers.get("Referer")
         if referer:
             self._pool.submit(httpd.get, referer.rstrip("/") + "/jobAdded")
         return 200, None
 
     def _handle_submit_delay(self, body: bytes, headers: dict):
-        """POST /delay — DELAY-path submit to Level0 + wait-timer start
-        (server.go:53-78). The device ingest phase starts the wait timer
-        and the on-state jobs_in_queue counter; the meter here mirrors the
-        handler-side OTel counter (server.go:75-76)."""
+        """POST /delay — submit to Level0 + wait-timer start
+        (server.go:53-78), again endpoint-routed, not policy-routed. The
+        device ingest phase starts the wait timer and the on-state
+        jobs_in_queue counter; the meter here mirrors the handler-side OTel
+        counter (server.go:75-76)."""
         try:
             job = job_from_json(json.loads(body))
         except ValueError:
             return 400, None
-        self._stage_arrival(job)
+        with self.tracer.start_span("receive_job", job_id=job[0]):
+            self._stage_arrival(job, delay=True)
         self.meter.add("jobs_in_queue", 1)
         return 200, None
 
@@ -175,20 +183,34 @@ class SchedulerService(Service):
     # ------------------------------------------------------------------
     # arrival staging (the tensor form of the submit handlers)
     # ------------------------------------------------------------------
-    def _stage_arrival(self, job) -> None:
+    def _stage_arrival(self, job, delay: bool) -> None:
         jid, cores, mem, dur_ms, _ = job
         with self._plock:
-            self._pending.append((jid, cores, mem, dur_ms))
+            self._pending.append((jid, cores, mem, dur_ms, delay))
 
     def _drain_pending(self) -> None:
-        """Move submitted jobs into the arrival ring, timestamped at the
-        current virtual time. Caller holds the state lock."""
+        """Move submitted jobs into the engine, timestamped at the current
+        virtual time. Caller holds the state lock.
+
+        Routing is by *endpoint*, as in the reference (server.go:22-78):
+        jobs submitted on the endpoint matching the configured policy
+        (``/delay`` for DELAY/FFD, ``/`` for FIFO) flow through the batched
+        arrival ring into the queue the policy drains; mismatched-endpoint
+        jobs are pushed straight into the queue the policy *ignores* —
+        where, exactly as in Go, they sit forever."""
         with self._plock:
             pending, self._pending = self._pending, []
         if not pending:
             return
         now = int(np.asarray(self.state.t))
-        for jid, cores, mem, dur_ms in pending:
+        delay_policy = self.cfg.policy is not PolicyKind.FIFO
+        for jid, cores, mem, dur_ms, delay in pending:
+            if delay != delay_policy:  # endpoint the policy never drains
+                vec = Q.JobRec.make(id=jid, cores=cores, mem=mem, dur=dur_ms,
+                                    enq_t=now).vec
+                op = host_ops.push_l0 if delay else host_ops.push_ready
+                self.state = op(self.state, vec)
+                continue
             if self._arr_n == self.cfg.max_arrivals:
                 self._compact_arrivals()
             if self._arr_n == self.cfg.max_arrivals:
@@ -254,6 +276,8 @@ class SchedulerService(Service):
         host_ops.push_lent(self.state, vec)
         host_ops.remove_borrowed(self.state, vec)
         host_ops.commit_borrow(self.state, vec)
+        host_ops.push_ready(self.state, vec)
+        host_ops.push_l0(self.state, vec)
 
     def _tick_loop(self) -> None:
         period = self.cfg.tick_ms / 1000.0 / self.speed
@@ -294,13 +318,17 @@ class SchedulerService(Service):
             url = self._owner_urls[owner]
             payload = job_to_json(row[R.RID], row[R.RCORES], row[R.RMEM],
                                   row[R.RDUR], ownership=url)
-            self._pool.submit(self._post_return, url, payload)
+            self._pool.submit(telemetry.wrap_ctx(self._post_return),
+                              url, payload)
 
     def _post_return(self, url: str, payload: dict) -> None:
-        for _ in range(RETURN_ATTEMPTS):
-            status, _ = httpd.post_json(url.rstrip("/") + "/lent", payload)
-            if status == 200:
-                return
+        """POST the finished job to the borrower's /lent, under a
+        ReturnToBorrower span (server.go:260-290)."""
+        with self.tracer.start_span("ReturnToBorrower", job_id=payload["Id"]):
+            for _ in range(RETURN_ATTEMPTS):
+                status, _ = httpd.post_json(url.rstrip("/") + "/lent", payload)
+                if status == 200:
+                    return
         self.logger.error("return to %s failed after %d attempts", url,
                           RETURN_ATTEMPTS)
 
@@ -324,16 +352,21 @@ class SchedulerService(Service):
         job = Q.JobRec(vec=vec)
         payload = job_to_json(int(job.id), int(job.cores), int(job.mem),
                               int(job.dur), ownership=self.url)
-        futs = {self._pool.submit(httpd.post_json, p.rstrip("/") + "/borrow",
-                                  payload): p for p in peers}
-        for fut in as_completed(futs, timeout=10):
-            status, _ = fut.result()
-            if status == 200:
-                with self._slock:
-                    self.state = host_ops.commit_borrow(self.state, vec)
-                self.logger.info("borrowed: job %d hosted by %s",
-                                 int(job.id), futs[fut])
-                break
+        # BorrowResources span: the /borrow POSTs inherit it via wrap_ctx,
+        # so the lender's server span parents onto this one (the
+        # borrower→lender causality the reference's otelhttp gives it)
+        with self.tracer.start_span("BorrowResources", job_id=int(job.id)):
+            futs = {self._pool.submit(
+                telemetry.wrap_ctx(httpd.post_json),
+                p.rstrip("/") + "/borrow", payload): p for p in peers}
+            for fut in as_completed(futs, timeout=10):
+                status, _ = fut.result()
+                if status == 200:
+                    with self._slock:
+                        self.state = host_ops.commit_borrow(self.state, vec)
+                    self.logger.info("borrowed: job %d hosted by %s",
+                                     int(job.id), futs[fut])
+                    break
 
     def _intern_owner(self, url: str) -> int:
         if url not in self._owner_idx:
@@ -394,6 +427,8 @@ class SchedulerService(Service):
             return {"t_ms": int(np.asarray(s.t)),
                     "placed_total": int(np.asarray(s.placed_total)[0]),
                     "jobs_in_queue": int(np.asarray(s.jobs_in_queue)[0]),
+                    "ready": int(np.asarray(s.ready.count)[0]),
+                    "l0": int(np.asarray(s.l0.count)[0]),
                     "lent": int(np.asarray(s.lent.count)[0]),
                     "borrowed": int(np.asarray(s.borrowed.count)[0]),
                     "running": int(np.asarray(s.run.active).sum()),

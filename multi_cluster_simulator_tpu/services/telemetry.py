@@ -20,6 +20,7 @@ exist without an external collector dependency:
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import os
@@ -27,9 +28,32 @@ import secrets
 import threading
 import time
 from contextlib import contextmanager
-from typing import Optional
+from typing import Callable, Optional
 
-TRACE_HEADER = "X-Trace-Context"  # traceparent analogue
+TRACE_HEADER = "X-Trace-Context"  # traceparent analogue (HTTP)
+TRACE_METADATA_KEY = "x-trace-context"  # gRPC metadata (keys must be lowercase)
+
+# The active span context ("trace_id:span_id") for this thread of execution —
+# the otel context.Context equivalent. start_span sets it for the span's
+# extent; transports (httpd.post_json, rpc clients) read it to inject the
+# propagation header, exactly as otelhttp.NewTransport / otelgrpc stats
+# handlers do in the reference (pkg/scheduler/server.go:47, trader.go:216).
+_CURRENT: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "mcs_trace_ctx", default=None)
+
+
+def current_context() -> Optional[str]:
+    """The propagatable "trace_id:span_id" of the innermost active span."""
+    return _CURRENT.get()
+
+
+def wrap_ctx(fn: Callable) -> Callable:
+    """Bind the caller's trace context (and the rest of its contextvars)
+    into ``fn`` for execution on another thread — the hand-rolled version of
+    Go's context.Context flowing through a goroutine fan-out
+    (pkg/scheduler/server.go:183-215)."""
+    ctx = contextvars.copy_context()
+    return lambda *a, **kw: ctx.run(fn, *a, **kw)
 
 
 def create_logger(service_name: str, mode: str = "development",
@@ -71,15 +95,22 @@ class Tracer:
 
     @contextmanager
     def start_span(self, name: str, parent: Optional[str] = None, **attrs):
-        """parent is a propagated "trace_id:span_id" context string."""
+        """Open a span. ``parent`` is a propagated "trace_id:span_id"
+        context string (from TRACE_HEADER / gRPC metadata); when omitted,
+        the innermost active span on this execution context is the parent —
+        so nested ``start_span`` calls chain automatically, like OTel's
+        implicit context."""
+        parent = parent or _CURRENT.get()
         trace_id, _, parent_id = (parent or "").partition(":")
         trace_id = trace_id or secrets.token_hex(8)
         span_id = secrets.token_hex(4)
         ctx = f"{trace_id}:{span_id}"
+        token = _CURRENT.set(ctx)
         t0 = time.time()
         try:
             yield ctx
         finally:
+            _CURRENT.reset(token)
             if self.path is not None:
                 row = {"service": self.service, "name": name,
                        "trace_id": trace_id, "span_id": span_id,

@@ -31,7 +31,7 @@ from multi_cluster_simulator_tpu.config import (
 )
 from multi_cluster_simulator_tpu.ops import queues as Q
 from multi_cluster_simulator_tpu.ops import sizing
-from multi_cluster_simulator_tpu.services import rpc
+from multi_cluster_simulator_tpu.services import rpc, telemetry
 from multi_cluster_simulator_tpu.services.lifecycle import Service
 from multi_cluster_simulator_tpu.services.proto import (
     resource_channel_pb2 as rc_pb,
@@ -163,7 +163,12 @@ class TraderService(Service):
                     self.logger.info("skipping zero-size contract "
                                      "(empty Level1 backlog)")
                     continue
-                won = self._trade(contract)
+                # the buyer-side trade span (trader.go:195,289,305): root of
+                # the cross-service trace; the gRPC fan-out below propagates
+                # its context to every seller
+                with self.tracer.start_span("Trade", cores=contract.cores,
+                                            memory=contract.memory):
+                    won = self._trade(contract)
                 cooldown = (self.tcfg.cooldown_success_ms if won
                             else self.tcfg.cooldown_failure_ms)
                 if self._stop.wait(cooldown / 1000.0 / self.speed):
@@ -210,8 +215,11 @@ class TraderService(Service):
         if not peers:
             return False
         window = TRADE_COLLECT_WINDOW_S / self.speed
-        futs = {self._pool.submit(self._peer(u).request_resource, contract,
-                                  max(window, 0.5)): u for u in peers}
+        # wrap_ctx carries the Trade span context onto the pool threads so
+        # each RequestResource RPC propagates it to the seller
+        futs = {self._pool.submit(
+            telemetry.wrap_ctx(self._peer(u).request_resource), contract,
+            max(window, 0.5)): u for u in peers}
         offers = []
         try:
             for fut in as_completed(futs, timeout=max(window, 0.5) + 1):

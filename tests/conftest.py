@@ -22,21 +22,24 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 from multi_cluster_simulator_tpu.config import SimConfig, WorkloadConfig  # noqa: E402
-from multi_cluster_simulator_tpu.core.spec import uniform_cluster  # noqa: E402
+from multi_cluster_simulator_tpu.core.spec import load_cluster_json  # noqa: E402
 from multi_cluster_simulator_tpu.workload.generator import generate_arrivals  # noqa: E402
+
+ASSETS = os.path.join(os.path.dirname(__file__), "..", "assets")
 
 
 @pytest.fixture(scope="session")
 def small_spec():
-    """The reference's cluster_small.json shape: 5 nodes x (32 cores, 24000 MB)
-    (assets/cluster_small.json)."""
-    return uniform_cluster(1, 5)
+    """The actual reference asset (assets/cluster_small.json, a copy of
+    /root/reference/assets/cluster_small.json): 5 nodes x (32 cores,
+    24000 MB), loaded through the Go JSON schema path (core/spec.py)."""
+    return load_cluster_json(os.path.join(ASSETS, "cluster_small.json"))
 
 
 @pytest.fixture(scope="session")
 def big_spec():
-    """cluster_big.json shape: 10 nodes x (32 cores, 24000 MB)."""
-    return uniform_cluster(2, 10)
+    """assets/cluster_big.json: 10 nodes x (32 cores, 24000 MB)."""
+    return load_cluster_json(os.path.join(ASSETS, "cluster_big.json"))
 
 
 def make_arrivals(cfg: SimConfig, n_clusters: int, horizon_ms: int, seed: int = 9,

@@ -1,0 +1,103 @@
+"""Checkpoint/resume: a run killed at a chunk boundary and resumed from
+disk must reach a final state bit-identical to an uninterrupted run (the
+capability the reference entirely lacks — SURVEY.md §5 checkpoint: absent)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+from multi_cluster_simulator_tpu.core.checkpoint import (
+    load_state, peek_checkpoint_t, save_state,
+)
+from multi_cluster_simulator_tpu.core.engine import Engine
+from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.workload.traces import borg_like_stream
+
+CFG = SimConfig(policy=PolicyKind.FFD, parity=False, max_placements_per_tick=16,
+                queue_capacity=128, max_running=256, max_arrivals=64,
+                max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=0,
+                n_res=2)
+
+
+def _setup(C=8):
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    arrivals = borg_like_stream(C, 64, 200_000, max_cores=32, max_mem=24_000,
+                                seed=19)
+    return init_state(CFG, specs), arrivals
+
+
+def test_resume_bit_identical(tmp_path):
+    """Borg-like replay killed mid-run: save at tick 120, load into a fresh
+    process-equivalent template, run the rest — every leaf of the final
+    state matches the uninterrupted run exactly."""
+    path = str(tmp_path / "ckpt.bin")
+    state0, arrivals = _setup()
+    run = Engine(CFG).run_jit()
+
+    straight = run(state0, arrivals, 240)
+
+    mid = run(state0, arrivals, 120)
+    save_state(mid, path)
+    assert peek_checkpoint_t(path) == 120 * CFG.tick_ms
+    del mid  # the "kill": nothing survives but the file
+
+    template = init_state(CFG, [uniform_cluster(c + 1, 5) for c in range(8)])
+    resumed = load_state(path, template)
+    final = run(resumed, arrivals, 120)
+
+    for a, b in zip(jax.tree.leaves(straight), jax.tree.leaves(final)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_rejects_other_config(tmp_path):
+    path = str(tmp_path / "ckpt.bin")
+    state0, _ = _setup()
+    save_state(state0, path)
+    other = dataclasses.replace(CFG, queue_capacity=64)
+    template = init_state(other, [uniform_cluster(c + 1, 5) for c in range(8)])
+    with pytest.raises(ValueError, match="checkpoint|mismatch"):
+        load_state(path, template)
+
+
+def test_checkpoint_rejects_garbage(tmp_path):
+    p = tmp_path / "junk.bin"
+    p.write_bytes(b"definitely not a checkpoint")
+    state0, _ = _setup()
+    with pytest.raises(ValueError, match="not a simulator checkpoint"):
+        load_state(str(p), state0)
+
+
+def test_bench_resume_flag(tmp_path):
+    """bench.py --checkpoint/--resume: a quick headline run interrupted
+    after its first chunk resumes from the file and finishes with the full
+    job count placed."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ck = str(tmp_path / "bench.ckpt")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run_bench(*extra):
+        return subprocess.run(
+            [sys.executable, "bench.py", "--config", "headline", "--quick",
+             "--checkpoint", ck, *extra],
+            cwd=repo, env=env, capture_output=True, text=True, timeout=900)
+
+    first = run_bench()
+    assert first.returncode == 0, first.stderr[-2000:]
+    assert os.path.exists(ck + ".headline")  # per-config checkpoint file
+    line = json.loads(first.stdout.strip().splitlines()[-1])
+    # resume from the completed checkpoint: nothing left to simulate, but
+    # the final state (and its placed_total) is all there
+    second = run_bench("--resume")
+    assert second.returncode == 0, second.stderr[-2000:]
+    assert "resumed from" in second.stderr
+    line2 = json.loads(second.stdout.strip().splitlines()[-1])
+    assert line["metric"] == line2["metric"]

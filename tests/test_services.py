@@ -127,6 +127,26 @@ def test_scheduler_live_delay_placement(registry):
         assert b"jobs_in_queue 10" in metrics
 
 
+def test_endpoint_routing_not_policy_routing(registry):
+    """Go's handlers route by endpoint, not configured algorithm
+    (server.go:22-78): under a DELAY config, a POST / job lands in the
+    ReadyQueue — which Delay() never drains — and sits forever, while
+    /delay jobs place normally (VERDICT r2 weak #7)."""
+    with SchedulerService("svc-route", uniform_cluster(1, 5), small_cfg(),
+                          registry_url=registry.url, speed=SPEED) as s:
+        status, _ = httpd.post_json(s.url + "/", job_to_json(900, 4, 2000, 30_000))
+        assert status == 200
+        status, _ = httpd.post_json(s.url + "/delay", job_to_json(901, 4, 2000, 30_000))
+        assert status == 200
+        wait_until(lambda: s.stats()["placed_total"] == 1,
+                   msg="/delay job placed")
+        wait_until(lambda: s.stats()["ready"] == 1, msg="/ job in ReadyQueue")
+        # the / job is parked exactly as in Go: present, never scheduled
+        time.sleep(0.5)
+        st = s.stats()
+        assert st["ready"] == 1 and st["placed_total"] == 1
+
+
 def test_scheduler_borrowing_over_http(registry):
     """Two FIFO schedulers: A's cluster can't fit the job, so its wait-head
     broadcast lands on B (/borrow), B hosts + runs it, then returns it to
