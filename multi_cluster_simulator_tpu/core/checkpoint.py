@@ -61,9 +61,10 @@ def load_state(path: str, template: SimState) -> SimState:
         payload = f.read()
     restored = serialization.from_bytes(template, payload)
     for a, b in zip(jax.tree.leaves(template), jax.tree.leaves(restored)):
-        if np.shape(a) != np.shape(b):
+        if np.shape(a) != np.shape(b) or np.asarray(a).dtype != np.asarray(b).dtype:
             raise ValueError(
-                f"checkpoint shape mismatch: {np.shape(b)} vs {np.shape(a)} "
+                f"checkpoint leaf mismatch: {np.shape(b)}/{np.asarray(b).dtype}"
+                f" vs {np.shape(a)}/{np.asarray(a).dtype} "
                 "— was it written under a different SimConfig?")
     return jax.tree.map(jnp.asarray, restored)
 

@@ -57,7 +57,11 @@ class Service:
         """Start background loops (tick threads, monitors)."""
 
     def on_shutdown(self) -> None:
-        """Stop background loops."""
+        """Stop background loops (runs while the HTTP surface still serves)."""
+
+    def on_stopped(self) -> None:
+        """Runs after the HTTP server is down — for final state snapshots
+        that must not race still-arriving mutations."""
 
     def on_providers_update(self, patch: dict) -> None:
         """Called when the registry pushes a provider patch."""
@@ -85,6 +89,7 @@ class Service:
             self.registry.shutdown()
         self.meter.stop_exporter()
         self.httpd.shutdown()
+        self.on_stopped()
         self.logger.info("%s at %s stopped", self.service_name, self.url)
 
     # -- context manager sugar for tests --

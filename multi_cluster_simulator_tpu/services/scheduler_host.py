@@ -24,6 +24,7 @@ used by the integration tests).
 from __future__ import annotations
 
 import json
+import os
 import threading
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Optional
@@ -68,8 +69,16 @@ class SchedulerService(Service):
 
     def __init__(self, name: str, spec: ClusterSpec, cfg: SimConfig,
                  registry_url: Optional[str] = None, speed: float = 1.0,
-                 grpc_port: Optional[int] = 0, **kw):
+                 grpc_port: Optional[int] = 0,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_period_ticks: int = 50, **kw):
         super().__init__(name, registry_url=registry_url, speed=speed, **kw)
+        # Live checkpointing (a capability the reference lacks — a Go
+        # scheduler restart loses every queue, SURVEY.md §5): persist the
+        # device state every N ticks; on start, restore from the file if it
+        # exists so queued/running work survives a process restart.
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_period_ticks = checkpoint_period_ticks
         # gRPC ResourceChannel for this cluster's trader; None disables it
         # (cmd/scheduler starts one alongside the HTTP server, main.go:62-79)
         self.grpc_port = grpc_port
@@ -245,6 +254,38 @@ class SchedulerService(Service):
     # tick loop (the Run goroutine, scheduler.go:101-124)
     # ------------------------------------------------------------------
     def on_start(self) -> None:
+        if (self.checkpoint_path is not None
+                and os.path.exists(self.checkpoint_path)):
+            from multi_cluster_simulator_tpu.core.checkpoint import load_state
+            # the HTTP surface is already serving (Service.start order), so
+            # the state swap must hold the lock or it could clobber an
+            # acknowledged mutation (e.g. a 200'd /borrow)
+            with self._slock:
+                self.state = load_state(self.checkpoint_path, self.state)
+                # the host arrival ring died with the old process; rebase the
+                # device cursor to the now-empty ring
+                consumed = int(np.asarray(self.state.arr_ptr)[0])
+                if consumed:
+                    self.state = host_ops.rebase_arrivals(self.state, consumed)
+                host = self.checkpoint_path + ".host"
+                if os.path.exists(host):
+                    with open(host) as f:
+                        side = json.load(f)
+                    # borrower table — without it, owner indices in the
+                    # restored lent queue could never be returned
+                    self._owner_urls = side["owner_urls"]
+                    self._owner_idx = {u: i for i, u
+                                       in enumerate(self._owner_urls) if i}
+                    # acknowledged-but-not-ingested jobs re-stage for the
+                    # first tick (they re-arrive at the restored clock)
+                    with self._plock:
+                        self._pending.extend(
+                            tuple(p) for p in side.get("pending", []))
+            self.logger.info(
+                "restored checkpoint %s (t=%d ms, %d running, %d queued)",
+                self.checkpoint_path, int(np.asarray(self.state.t)),
+                int(np.asarray(self.state.run.active).sum()),
+                int(np.asarray(self.state.jobs_in_queue)[0]))
         self._warmup()
         if self.grpc_port is not None:
             from multi_cluster_simulator_tpu.services import rpc
@@ -264,6 +305,36 @@ class SchedulerService(Service):
         if self._tick_thread is not None:
             self._tick_thread.join(timeout=10)
         self._pool.shutdown(wait=False)
+
+    def on_stopped(self) -> None:
+        # final graceful snapshot — taken only after the HTTP surface is
+        # down, so no acknowledged mutation (e.g. a 200'd /borrow) can land
+        # after the state we persist
+        if self.checkpoint_path is not None:
+            with self._slock:
+                self._save_checkpoint()
+
+    def _save_checkpoint(self) -> None:
+        """Persist the device state plus the host-side pieces the state's
+        indices are meaningless without: the borrower table (owner indices
+        in the lent queue) and every 200-acknowledged job that hasn't been
+        device-ingested yet (the pending list and the unconsumed tail of
+        the arrival ring). Caller holds the state lock."""
+        from multi_cluster_simulator_tpu.core.checkpoint import save_state
+        save_state(self.state, self.checkpoint_path)
+        delay_policy = self.cfg.policy is not PolicyKind.FIFO
+        with self._plock:
+            pending = [list(p) for p in self._pending]
+        consumed = int(np.asarray(self.state.arr_ptr)[0])
+        for i in range(consumed, self._arr_n):  # staged but not ingested
+            pending.append([int(self._arr["id"][0, i]),
+                            int(self._arr["cores"][0, i]),
+                            int(self._arr["mem"][0, i]),
+                            int(self._arr["dur"][0, i]), delay_policy])
+        tmp = self.checkpoint_path + ".host.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"owner_urls": self._owner_urls, "pending": pending}, f)
+        os.replace(tmp, self.checkpoint_path + ".host")
 
     def _warmup(self) -> None:
         """Compile the tick and the handler-path host ops before serving
@@ -295,6 +366,10 @@ class SchedulerService(Service):
             io = jax.tree.map(np.asarray, io)
             t = int(np.asarray(state.t))
         self.ticks_run += 1
+        if (self.checkpoint_path is not None
+                and self.ticks_run % self.checkpoint_period_ticks == 0):
+            with self._slock:
+                self._save_checkpoint()
         # waitTime histogram on the reference's 5 s metric cadence
         # (metrics.go:19-30)
         if t % 5_000 == 0:
