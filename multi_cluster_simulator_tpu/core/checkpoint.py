@@ -33,12 +33,16 @@ from multi_cluster_simulator_tpu.core.state import SimState
 _MAGIC = b"MCSCKPT1"
 
 
-def save_state(state: SimState, path: str) -> None:
+def save_state(state: SimState, path: str, extra: Optional[dict] = None) -> None:
     """Write a checkpoint. Atomic: written to ``path + '.tmp'`` then
-    renamed, so a kill mid-write never corrupts an existing checkpoint."""
+    renamed, so a kill mid-write never corrupts an existing checkpoint.
+
+    ``extra`` is an arbitrary JSON-able dict stored in the header — hosts
+    use it for state the tensors can't carry (borrower URL table, pending
+    jobs); keeping it in the same file keeps the pair atomic."""
     state = jax.tree.map(np.asarray, state)  # device -> host once
     payload = serialization.to_bytes(state)
-    header = json.dumps({"t": int(state.t)}).encode()
+    header = json.dumps({"t": int(state.t), "extra": extra or {}}).encode()
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(_MAGIC)
@@ -69,11 +73,21 @@ def load_state(path: str, template: SimState) -> SimState:
     return jax.tree.map(jnp.asarray, restored)
 
 
-def peek_checkpoint_t(path: str) -> int:
-    """The checkpoint's virtual time (ms) without deserializing the state —
-    lets a driver compute how many ticks remain before paying the load."""
+def _read_header(path: str) -> dict:
     with open(path, "rb") as f:
         if f.read(len(_MAGIC)) != _MAGIC:
             raise ValueError(f"{path}: not a simulator checkpoint")
         (hlen,) = _struct.unpack("<I", f.read(4))
-        return int(json.loads(f.read(hlen))["t"])
+        return json.loads(f.read(hlen))
+
+
+def peek_checkpoint_t(path: str) -> int:
+    """The checkpoint's virtual time (ms) without deserializing the state —
+    lets a driver compute how many ticks remain before paying the load."""
+    return int(_read_header(path)["t"])
+
+
+def load_extra(path: str) -> dict:
+    """The host-side ``extra`` dict stored alongside the state (empty for
+    checkpoints written without one)."""
+    return _read_header(path).get("extra") or {}

@@ -83,6 +83,9 @@ class Drops:
     vslot: jax.Array  # [C] i32 — trade won but no free virtual-node slot
     carve: jax.Array  # [C] i32 — carve planned on a node but no free
     #                      RunningSet slot for the Foreign placeholder
+    ingest: jax.Array  # [C] i32 — arrivals due this tick but deferred by the
+    #                      max_ingest_per_tick window (Go ingests all due
+    #                      arrivals at once; a binding window skews timing)
 
 
 @struct.dataclass
@@ -209,7 +212,8 @@ def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec]) -> SimState:
         wait_jobs=zi,
         jobs_in_queue=zi,
         placed_total=zi,
-        drops=Drops(queue=zi, msgs=zi, run_full=zi, vslot=zi, carve=zi),
+        drops=Drops(queue=zi, msgs=zi, run_full=zi, vslot=zi, carve=zi,
+                    ingest=zi),
         trader=TraderState(
             snap_core_util=zf,
             snap_mem_util=zf,

@@ -109,6 +109,37 @@ class SchedulerService(Service):
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix=f"{name}-io")
         self.ticks_run = 0
+        # Restore here, in __init__ — before Service.start() brings the
+        # HTTP surface up — so no acknowledged mutation can ever precede
+        # (and be clobbered by) the state swap.
+        if checkpoint_path is not None and os.path.exists(checkpoint_path):
+            self._restore_checkpoint()
+
+    def _restore_checkpoint(self) -> None:
+        from multi_cluster_simulator_tpu.core.checkpoint import (
+            load_extra, load_state,
+        )
+        self.state = load_state(self.checkpoint_path, self.state)
+        # the host arrival ring died with the old process; rebase the
+        # device cursor to the now-empty ring
+        consumed = int(np.asarray(self.state.arr_ptr)[0])
+        if consumed:
+            self.state = host_ops.rebase_arrivals(self.state, consumed)
+        extra = load_extra(self.checkpoint_path)
+        if extra:
+            # borrower table — without it, owner indices in the restored
+            # lent queue could never be returned
+            self._owner_urls = extra["owner_urls"]
+            self._owner_idx = {u: i for i, u
+                               in enumerate(self._owner_urls) if i}
+            # acknowledged-but-not-ingested jobs re-stage for the first
+            # tick (they re-arrive at the restored clock)
+            self._pending.extend(tuple(p) for p in extra.get("pending", []))
+        self.logger.info(
+            "restored checkpoint %s (t=%d ms, %d running, %d queued)",
+            self.checkpoint_path, int(np.asarray(self.state.t)),
+            int(np.asarray(self.state.run.active).sum()),
+            int(np.asarray(self.state.jobs_in_queue)[0]))
 
     # ------------------------------------------------------------------
     # HTTP surface (RegisterHandlers, server.go:22-153)
@@ -254,38 +285,6 @@ class SchedulerService(Service):
     # tick loop (the Run goroutine, scheduler.go:101-124)
     # ------------------------------------------------------------------
     def on_start(self) -> None:
-        if (self.checkpoint_path is not None
-                and os.path.exists(self.checkpoint_path)):
-            from multi_cluster_simulator_tpu.core.checkpoint import load_state
-            # the HTTP surface is already serving (Service.start order), so
-            # the state swap must hold the lock or it could clobber an
-            # acknowledged mutation (e.g. a 200'd /borrow)
-            with self._slock:
-                self.state = load_state(self.checkpoint_path, self.state)
-                # the host arrival ring died with the old process; rebase the
-                # device cursor to the now-empty ring
-                consumed = int(np.asarray(self.state.arr_ptr)[0])
-                if consumed:
-                    self.state = host_ops.rebase_arrivals(self.state, consumed)
-                host = self.checkpoint_path + ".host"
-                if os.path.exists(host):
-                    with open(host) as f:
-                        side = json.load(f)
-                    # borrower table — without it, owner indices in the
-                    # restored lent queue could never be returned
-                    self._owner_urls = side["owner_urls"]
-                    self._owner_idx = {u: i for i, u
-                                       in enumerate(self._owner_urls) if i}
-                    # acknowledged-but-not-ingested jobs re-stage for the
-                    # first tick (they re-arrive at the restored clock)
-                    with self._plock:
-                        self._pending.extend(
-                            tuple(p) for p in side.get("pending", []))
-            self.logger.info(
-                "restored checkpoint %s (t=%d ms, %d running, %d queued)",
-                self.checkpoint_path, int(np.asarray(self.state.t)),
-                int(np.asarray(self.state.run.active).sum()),
-                int(np.asarray(self.state.jobs_in_queue)[0]))
         self._warmup()
         if self.grpc_port is not None:
             from multi_cluster_simulator_tpu.services import rpc
@@ -311,30 +310,36 @@ class SchedulerService(Service):
         # down, so no acknowledged mutation (e.g. a 200'd /borrow) can land
         # after the state we persist
         if self.checkpoint_path is not None:
-            with self._slock:
-                self._save_checkpoint()
+            self._save_checkpoint()
 
     def _save_checkpoint(self) -> None:
         """Persist the device state plus the host-side pieces the state's
         indices are meaningless without: the borrower table (owner indices
         in the lent queue) and every 200-acknowledged job that hasn't been
         device-ingested yet (the pending list and the unconsumed tail of
-        the arrival ring). Caller holds the state lock."""
+        the arrival ring). Everything lands in ONE atomic file (the extra
+        header of core/checkpoint.py), so a kill can never leave a
+        state/sidecar pair from different moments.
+
+        Only the reference snapshot happens under the lock — SimState is an
+        immutable pytree, so serialization and disk I/O run outside it and
+        never stall the HTTP handlers or the tick loop."""
         from multi_cluster_simulator_tpu.core.checkpoint import save_state
-        save_state(self.state, self.checkpoint_path)
         delay_policy = self.cfg.policy is not PolicyKind.FIFO
-        with self._plock:
-            pending = [list(p) for p in self._pending]
-        consumed = int(np.asarray(self.state.arr_ptr)[0])
-        for i in range(consumed, self._arr_n):  # staged but not ingested
-            pending.append([int(self._arr["id"][0, i]),
-                            int(self._arr["cores"][0, i]),
-                            int(self._arr["mem"][0, i]),
-                            int(self._arr["dur"][0, i]), delay_policy])
-        tmp = self.checkpoint_path + ".host.tmp"
-        with open(tmp, "w") as f:
-            json.dump({"owner_urls": self._owner_urls, "pending": pending}, f)
-        os.replace(tmp, self.checkpoint_path + ".host")
+        with self._slock:
+            state = self.state  # immutable snapshot by reference
+            arr_n = self._arr_n
+            ring = {k: a[0, :arr_n].copy() for k, a in self._arr.items()}
+            with self._plock:
+                pending = [list(p) for p in self._pending]
+            owner_urls = list(self._owner_urls)
+        consumed = int(np.asarray(state.arr_ptr)[0])
+        for i in range(consumed, arr_n):  # staged but not ingested
+            pending.append([int(ring["id"][i]), int(ring["cores"][i]),
+                            int(ring["mem"][i]), int(ring["dur"][i]),
+                            delay_policy])
+        save_state(state, self.checkpoint_path,
+                   extra={"owner_urls": owner_urls, "pending": pending})
 
     def _warmup(self) -> None:
         """Compile the tick and the handler-path host ops before serving
@@ -368,8 +373,7 @@ class SchedulerService(Service):
         self.ticks_run += 1
         if (self.checkpoint_path is not None
                 and self.ticks_run % self.checkpoint_period_ticks == 0):
-            with self._slock:
-                self._save_checkpoint()
+            self._save_checkpoint()
         # waitTime histogram on the reference's 5 s metric cadence
         # (metrics.go:19-30)
         if t % 5_000 == 0:

@@ -63,7 +63,7 @@ def total_drops(state: SimState) -> dict:
     correctly sized config (see core/state.py Drops)."""
     d = state.drops
     return {k: int(np.asarray(getattr(d, k)).sum())
-            for k in ("queue", "msgs", "run_full", "vslot", "carve")}
+            for k in ("queue", "msgs", "run_full", "vslot", "carve", "ingest")}
 
 
 def assert_no_drops(state: SimState) -> None:

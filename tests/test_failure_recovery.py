@@ -185,8 +185,7 @@ def test_checkpoint_preserves_acked_but_uningested_jobs(tmp_path):
                          checkpoint_path=ck)
     # never started: the job sits in _pending exactly as in the shutdown race
     s._stage_arrival((7, 4, 2000, 30_000, ""), delay=True)
-    with s._slock:
-        s._save_checkpoint()
+    s._save_checkpoint()
     with SchedulerService("svc-fr-pend2", spec, cfg, speed=SPEED,
                           checkpoint_path=ck) as s2:
         wait_until(lambda: s2.stats()["placed_total"] == 1,

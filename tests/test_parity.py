@@ -53,8 +53,11 @@ def assert_stats_equal(state, oracle, n_clusters):
         assert np.isclose(float(state.wait_total[c]), float(cl.wait_total), rtol=1e-6)
 
 
+# max_ingest_per_tick=128: the generator reproduces the Go client's
+# minute-boundary bursts (60+ jobs in one tick at high lambda); the default
+# 64-slot window would defer some — caught by Drops.ingest in assert_no_drops
 BASE = SimConfig(record_trace=True, queue_capacity=64, max_running=512,
-                 max_arrivals=2048, max_nodes=12)
+                 max_arrivals=2048, max_nodes=12, max_ingest_per_tick=128)
 
 
 class TestDelayParity:
