@@ -231,15 +231,13 @@ def _ingest_local(s: SimState, arr_rows: jax.Array, arr_n: jax.Array, t,
     K = min(cfg.max_ingest_per_tick, A)
     a = jnp.arange(A, dtype=jnp.int32)
     in_window = jnp.logical_and(a >= s.arr_ptr, a < s.arr_ptr + K)
-    elig = jnp.logical_and(
-        jnp.logical_and(in_window, a < arr_n),
-        arr_rows[:, Q.FENQ] <= t)  # prefix of the window (time-sorted)
+    due = jnp.logical_and(jnp.logical_and(a >= s.arr_ptr, a < arr_n),
+                          arr_rows[:, Q.FENQ] <= t)  # everything Go ingests now
+    elig = jnp.logical_and(due, in_window)  # what fits this tick's window
     n = jnp.sum(elig).astype(jnp.int32)
     # due arrivals beyond the window slip to the next tick — a timing
-    # divergence from Go (which ingests everything due); count it so parity
-    # runs can assert the window never bound (Drops.ingest)
-    due = jnp.logical_and(jnp.logical_and(a >= s.arr_ptr, a < arr_n),
-                          arr_rows[:, Q.FENQ] <= t)
+    # divergence from Go; count it so parity runs can assert the window
+    # never bound (Drops.ingest)
     deferred = (jnp.sum(due) - n).astype(jnp.int32)
     s = s.replace(drops=s.drops.replace(ingest=s.drops.ingest + deferred))
     hot = (a[None, :] == (s.arr_ptr + jnp.arange(K, dtype=jnp.int32))[:, None])

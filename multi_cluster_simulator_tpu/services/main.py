@@ -52,7 +52,7 @@ def cmd_scheduler(args) -> None:
                     trader=TraderConfig(enabled=False))
     svc = SchedulerService(args.name, load_cluster_json(args.cluster_json),
                            cfg, registry_url=args.registry, speed=args.speed,
-                           port=args.port)
+                           port=args.port, checkpoint_path=args.checkpoint)
     svc.start()
     print(f"scheduler HTTP {svc.url} gRPC {svc.grpc_addr}", flush=True)
     _wait_for_key(svc.name)
@@ -105,6 +105,9 @@ def main(argv=None) -> None:
     p.add_argument("--name", default="Scheduler")
     p.add_argument("--policy", default="DELAY", choices=["FIFO", "DELAY", "FFD"])
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--checkpoint", default=None, metavar="PATH",
+                   help="persist state here every 50 ticks and restore on "
+                        "start (queued/running work survives restarts)")
     p.set_defaults(fn=cmd_scheduler)
 
     p = sub.add_parser("trader")

@@ -113,7 +113,16 @@ class SchedulerService(Service):
         # HTTP surface up — so no acknowledged mutation can ever precede
         # (and be clobbered by) the state swap.
         if checkpoint_path is not None and os.path.exists(checkpoint_path):
-            self._restore_checkpoint()
+            try:
+                self._restore_checkpoint()
+            except Exception as e:
+                # an unreadable/incompatible checkpoint (older format,
+                # different config) must not brick the service — start
+                # fresh and say so loudly
+                self.state = init_state(cfg, [spec])
+                self.logger.error(
+                    "checkpoint %s not restorable (%r); starting fresh",
+                    checkpoint_path, e)
 
     def _restore_checkpoint(self) -> None:
         from multi_cluster_simulator_tpu.core.checkpoint import (
