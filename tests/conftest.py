@@ -51,3 +51,13 @@ def make_arrivals(cfg: SimConfig, n_clusters: int, horizon_ms: int, seed: int = 
                   max_cores: int = 32, max_mem: int = 24_000):
     return generate_arrivals(cfg.workload, n_clusters, cfg.max_arrivals,
                              horizon_ms, max_cores, max_mem, seed=seed)
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (bind/release; tiny TOCTOU window is
+    acceptable for tests)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -4,85 +4,105 @@ reference's five mains do (internal/service/service.go:44-55)."""
 
 import json
 import os
-import socket
+import queue
 import subprocess
 import sys
+import threading
 import time
+import urllib.error
 import urllib.request
+
+from tests.conftest import free_port
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+class _Proc:
+    """A CLI child whose stdout is drained by a reader thread, so awaiting
+    a line can enforce a real deadline (a bare readline() would block the
+    suite forever if the child wedges silently)."""
+
+    def __init__(self, reg_port, *args):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(REPO, ".jax_cache"))
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "multi_cluster_simulator_tpu.services.main",
+             "--speed", "200", "--registry", f"http://127.0.0.1:{reg_port}",
+             *args],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, cwd=REPO)
+        self._lines: queue.Queue = queue.Queue()
+        t = threading.Thread(target=self._drain, daemon=True)
+        t.start()
+
+    def _drain(self):
+        for line in self.proc.stdout:
+            self._lines.put(line)
+
+    def await_line(self, prefix, timeout=300):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                line = self._lines.get(timeout=min(1.0, deadline - time.time()))
+            except queue.Empty:
+                assert self.proc.poll() is None, \
+                    f"process died waiting for {prefix!r}"
+                continue
+            if line.startswith(prefix):
+                return line.strip()
+        raise AssertionError(f"timed out waiting for line {prefix!r}")
+
+    def stop(self):
+        if self.proc.poll() is None:
+            try:
+                self.proc.stdin.write("\n")
+                self.proc.stdin.flush()
+                self.proc.wait(timeout=30)
+            except Exception:
+                self.proc.kill()
 
 
-def _launch(reg_port, *args):
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(REPO, ".jax_cache"))
-    return subprocess.Popen(
-        [sys.executable, "-m", "multi_cluster_simulator_tpu.services.main",
-         "--speed", "200", "--registry", f"http://127.0.0.1:{reg_port}",
-         *args],
-        env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT, text=True, cwd=REPO)
-
-
-def _await_line(proc, prefix, timeout=300):
-    t0 = time.time()
-    while time.time() - t0 < timeout:
-        line = proc.stdout.readline()
-        if not line:
-            assert proc.poll() is None, f"process died waiting for {prefix!r}"
-            time.sleep(0.1)
-            continue
-        if line.startswith(prefix):
-            return line.strip()
-    raise AssertionError(f"timed out waiting for line {prefix!r}")
-
-
-def _stop(proc):
-    if proc.poll() is None:
-        try:
-            proc.stdin.write("\n")
-            proc.stdin.flush()
-            proc.wait(timeout=30)
-        except Exception:
-            proc.kill()
+def _get(url, timeout=5.0):
+    """GET that treats transient errors as 'not yet' (the scheduler's HTTP
+    thread can stall multi-second during a cold XLA compile)."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    except (urllib.error.URLError, OSError, TimeoutError):
+        return None
 
 
 def test_cli_registry_scheduler_client_topology(tmp_path):
-    reg_port = _free_port()
-    reg = _launch(reg_port, "registry", "--port", str(reg_port))
+    reg_port = free_port()
+    ck = str(tmp_path / "s.ckpt")
+    reg = _Proc(reg_port, "registry", "--port", str(reg_port))
     sched = client = None
     try:
-        _await_line(reg, "registry at ")
-        sched = _launch(reg_port, "scheduler", "assets/cluster_small.json",
-                        "--checkpoint", str(tmp_path / "s.ckpt"))
-        line = _await_line(sched, "scheduler HTTP ")
-        url = line.split()[2]
+        reg.await_line("registry at ")
+        sched = _Proc(reg_port, "scheduler", "assets/cluster_small.json",
+                      "--checkpoint", ck)
+        url = sched.await_line("scheduler HTTP ").split()[2]
         # wire surface answers with the Go Cluster JSON
-        with urllib.request.urlopen(url + "/newClient", timeout=5) as r:
-            cluster = json.loads(r.read())
-        assert len(cluster["Nodes"]) == 5
+        body = _get(url + "/newClient")
+        assert body is not None and len(json.loads(body)["Nodes"]) == 5
         # a workload client joins via /newClient and streams jobs
-        client = _launch(reg_port, "client", url, "--max-jobs", "5")
-        t0 = time.time()
-        placed = 0
-        # generous: a cold compile cache plus full-suite load can put
-        # minutes between launch and the first placement
-        while time.time() - t0 < 240 and placed < 1:
-            with urllib.request.urlopen(url + "/metrics", timeout=5) as r:
-                body = r.read().decode()
-            placed = sum("jobs_in_queue" in ln for ln in body.splitlines())
+        client = _Proc(reg_port, "client", url, "--max-jobs", "5")
+        deadline = time.time() + 240  # cold-compile worst case
+        seen = ""
+        while time.time() < deadline:
+            body = _get(url + "/metrics")
+            if body is not None:
+                seen = body
+                if "jobs_in_queue" in body:
+                    break
             time.sleep(0.3)
-        assert placed >= 1, f"scheduler meter never saw client jobs:\n{body}"
+        else:
+            raise AssertionError(
+                f"scheduler meter never saw client jobs:\n{seen}")
     finally:
         for p in (client, sched, reg):
             if p is not None:
-                _stop(p)
-    assert os.path.exists(tmp_path / "s.ckpt"), "graceful-stop checkpoint"
+                p.stop()
+    assert os.path.exists(ck), "checkpoint file written"

@@ -9,7 +9,6 @@ ReturnToBorrower gives up after 3 attempts without crashing the lender
 (pkg/scheduler/server.go:275-289). Each is exercised here with real fault
 injection — the tests the reference never had."""
 
-import socket
 import time
 
 from multi_cluster_simulator_tpu.core.spec import uniform_cluster
@@ -21,6 +20,7 @@ from multi_cluster_simulator_tpu.services.scheduler_host import (
     SchedulerService, job_to_json,
 )
 from multi_cluster_simulator_tpu.services.trader_host import TraderService
+from tests.conftest import free_port
 from tests.test_services import SPEED, small_cfg, wait_until
 
 
@@ -54,12 +54,6 @@ def test_heartbeat_recovery_readds_service():
         flappy.shutdown(), watcher.shutdown(), reg.shutdown()
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def test_trader_survives_scheduler_restart():
     """Kill the trader's scheduler mid-stream: the consumer's retry loop
     keeps the trader alive, and when a scheduler comes back on the same
@@ -68,7 +62,7 @@ def test_trader_survives_scheduler_restart():
     return + trader.Run's loop)."""
     reg = RegistryServer(port=0, speed=SPEED)
     reg.start()
-    port = _free_port()
+    port = free_port()
     cfg = small_cfg()
     try:
         a = SchedulerService("svc-fr-sched", uniform_cluster(1, 2), cfg,
