@@ -37,6 +37,31 @@ def _specs(C):
     return out
 
 
+def test_sharded_metrics_series_matches_local():
+    """record_metrics under shard_map: the [T, C] series comes back with
+    its cluster axis resharded and bit-equal to the local run's."""
+    cfg = SimConfig(policy=PolicyKind.DELAY, record_metrics=True,
+                    queue_capacity=64, max_running=256, max_arrivals=1024,
+                    max_nodes=12)
+    C = 8
+    specs = _specs(C)
+    arrivals = make_arrivals(cfg, C, horizon_ms=120_000, seed=17,
+                             max_cores=16, max_mem=8_000)
+    state0 = init_state(cfg, specs)
+    local, lseries = Engine(cfg).run_jit()(state0, arrivals, 120)
+
+    sh = ShardedEngine(cfg, make_mesh(8))
+    sstate, sarr = sh.shard_inputs(state0, arrivals)
+    sharded, sseries = sh.run_fn(120)(sstate, sarr)
+    _assert_states_equal(local, sharded)
+    np.testing.assert_array_equal(np.asarray(lseries.jobs_in_queue),
+                                  np.asarray(sseries.jobs_in_queue))
+    np.testing.assert_allclose(np.asarray(lseries.avg_wait_ms),
+                               np.asarray(sseries.avg_wait_ms))
+    np.testing.assert_array_equal(np.asarray(lseries.t),
+                                  np.asarray(sseries.t))
+
+
 @pytest.mark.parametrize("n_dev", [2, 8])
 def test_fifo_borrowing_sharded_matches_local(n_dev):
     cfg = SimConfig(policy=PolicyKind.FIFO, borrowing=True, record_trace=True,
