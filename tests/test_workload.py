@@ -55,8 +55,8 @@ def test_weibull_delay_parity(small_spec):
     too (client.go:132-135's Weibull branch)."""
     wl = WorkloadConfig(arrival="weibull", weibull_lambda_s=5.0)
     cfg = dataclasses.replace(BASE, policy=PolicyKind.DELAY, workload=wl)
-    arrivals = generate_arrivals(cfg.workload, 1, cfg.max_arrivals,
-                                 300_000, 32, 24_000, seed=21)
+    from tests.conftest import make_arrivals
+    arrivals = make_arrivals(cfg, 1, horizon_ms=300_000, seed=21)
     state = Engine(cfg).run_jit()(init_state(cfg, [small_spec]), arrivals, 300)
     oracle = Oracle(cfg, [small_spec], arrivals).run(300)
     assert len(oracle.trace) > 5, "weibull stream produced too few placements"
