@@ -62,6 +62,10 @@ class TraderConfig:
     cooldown_failure_ms: int = 120_000  # 2 min sleep after failure, trader.go:302
     state_cadence_ms: int = 5_000  # scheduler state stream, trader_server.go:42
     contract_ttl_ms: int = 20_000  # seller contract validity, trader/server.go:49
+    # Batch-market-only knob (market/trader.py). The live TraderService
+    # always speaks the reference's pairwise gRPC protocol, which is greedy
+    # by construction (fan-out + cheapest approver, trader.go:193-278) — a
+    # live Sinkhorn would need a central matcher that protocol doesn't have.
     matching: MatchKind = MatchKind.GREEDY
     sinkhorn_iters: int = 16  # entropic-OT iterations (market/trader.py)
     sinkhorn_eps: float = 0.05  # entropic regularization temperature
