@@ -229,6 +229,53 @@ def test_trader_market_end_to_end(registry):
             assert b.stats()["running"] >= 1
 
 
+def test_trader_waittime_policy_fast_contract(registry, tmp_path):
+    """The live monitor's OTHER request policy: average wait exceeds the
+    WaitTime threshold -> fastNode sizing -> trade (trader.go:286-296, the
+    branch the utilization-driven e2e never takes). The utilization policy
+    is disabled (thresholds > 1) so only WaitTime can fire. Also pins the
+    Meter's periodic JSONL exporter (CreateMeterProvider's PeriodicReader,
+    telemetry.go:94-119)."""
+    import json as _json
+    cfg = small_cfg()
+    tcfg = TraderConfig(request_core_max=2.0, request_mem_max=2.0,
+                        request_max_wait_ms=30_000.0,
+                        cooldown_success_ms=30_000)
+    metrics = str(tmp_path / "meter.jsonl")
+    a = SchedulerService("svc-wt-sa", uniform_cluster(1, 2), cfg,
+                         registry_url=registry.url, speed=SPEED,
+                         metrics_path=metrics)
+    b = SchedulerService("svc-wt-sb", uniform_cluster(2, 5), cfg,
+                         registry_url=registry.url, speed=SPEED)
+    with a, b:
+        ta = TraderService("svc-wt-ta", a.grpc_addr, tcfg=tcfg,
+                           registry_url=registry.url, speed=SPEED)
+        tb = TraderService("svc-wt-tb", b.grpc_addr, tcfg=tcfg,
+                           registry_url=registry.url, speed=SPEED)
+        with ta, tb:
+            wait_until(lambda: len(ta.registry._providers.get(SERVICE_TRADER, [])) == 2,
+                       msg="traders discovered")
+            # saturate A and leave a 5th job queueing: its wait climbs past
+            # the 30s threshold and the WaitTime policy breaks
+            for i in range(5):
+                httpd.post_json(a.url + "/delay",
+                                job_to_json(i + 1, 16, 12_000, 60_000_000))
+            wait_until(lambda: ta.trades_won >= 1, timeout=90,
+                       msg="fast-node trade won")
+            wait_until(lambda: a.stats()["placed_total"] == 5, timeout=90,
+                       msg="overflow placed via the fast-node trade")
+    # the meter exporter flushed snapshots with the jobs_in_queue counter
+    wait_until(lambda: pathlib_exists_nonempty(metrics), timeout=30,
+               msg="meter export file")
+    rows = [_json.loads(l) for l in open(metrics) if l.strip()]
+    assert any(r["counters"].get("jobs_in_queue") for r in rows)
+
+
+def pathlib_exists_nonempty(p):
+    import os
+    return os.path.exists(p) and os.path.getsize(p) > 0
+
+
 # ---------------------------------------------------------------------------
 # workload client + log sink + full constellation
 # ---------------------------------------------------------------------------
