@@ -17,7 +17,7 @@ simulated time — per-config speedups vs that bound are in the details file.
 Usage:
   python bench.py                 # headline (north star)
   python bench.py --config NAME   # fifo_small | fifo_two_trader | ffd64 |
-                                  # sinkhorn | borg4k | headline
+                                  # sinkhorn | borg4k | scale16k | headline
   python bench.py --all           # every config; details to bench_results.json
 """
 
@@ -111,23 +111,23 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     return out, wall_s, compile_s, series, info
 
 
-def bench_headline(quick=False):
-    """North star: 1M+ jobs x 4096 clusters, FIFO parity semantics."""
+def _fifo_parity_scale(C, jobs_per, metric, repeats=3, extra_note=None):
+    """Shared body for the FIFO-parity scale configs (headline + scale16k):
+    one definition, so bound tuning can never silently diverge between the
+    north-star run and its 4x headroom variant."""
     from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
     from multi_cluster_simulator_tpu.core.spec import uniform_cluster
     from multi_cluster_simulator_tpu.workload.traces import uniform_stream
 
-    C = 256 if quick else 4096
-    jobs_per = 250  # C * jobs_per >= 1M at full scale
     horizon_ms = 1_500_000
     # parity=True: the engine's placement sweeps are bounded while loops, so
-    # full Go-loop semantics cost the same as the capped fast mode — the
-    # headline runs the real parity semantics, no equivalence argument needed.
+    # full Go-loop semantics cost the same as the capped fast mode — these
+    # configs run the real parity semantics, no equivalence argument needed.
     # Static bounds are sized to the workload's measured maxima (r3 probes:
     # queue 24 / running 32 / ingest 8 shaves ~35% of wall vs 64/32/16); the
-    # zero-drops assert below — which now includes the ingest-window
-    # deferral counter — proves none of them ever binds, i.e. the run is
-    # observably identical to unbounded Go semantics.
+    # zero-drops assert below — which includes the ingest-window deferral
+    # counter — proves none of them ever binds, i.e. the run is observably
+    # identical to unbounded Go semantics.
     cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=24, max_running=32,
                     max_arrivals=jobs_per, max_ingest_per_tick=8,
                     parity=True, n_res=2,
@@ -138,7 +138,9 @@ def bench_headline(quick=False):
     n_ticks = horizon_ms // cfg.tick_ms + 70  # drain tail
     out, wall_s, compile_s, _, info = _engine_run(cfg, specs, arrivals,
                                                   n_ticks, use_mesh=True,
-                                                  chunk=400)
+                                                  chunk=400, repeats=repeats)
+    import jax
+
     from multi_cluster_simulator_tpu.utils.trace import total_drops
 
     placed = int(np.asarray(out.placed_total).sum())
@@ -146,21 +148,31 @@ def bench_headline(quick=False):
     assert placed >= 0.99 * total, f"only {placed}/{total} jobs placed"
     drops = total_drops(out)
     assert all(v == 0 for v in drops.values()), (
-        f"headline static bounds bound ({drops}) — results would diverge "
+        f"static bounds bound ({drops}) — results would diverge "
         "from the unbounded Go semantics; resize the config")
     # on a --resume run, wall_s covers only the remaining ticks — rate the
     # jobs placed by THIS invocation, not the checkpoint's
     jobs_per_sec = (placed - info["placed_before_resume"]) / max(wall_s, 1e-9)
+    detail = {"jobs": placed, "clusters": C, "wall_s": round(wall_s, 3),
+              "compile_s": round(compile_s, 1), "ticks": n_ticks,
+              "sim_horizon_s": n_ticks, "drops": drops,
+              "devices": len(jax.devices()),
+              "speedup_vs_wallclock_reference": round(n_ticks / wall_s, 1)}
+    if extra_note:
+        detail["note"] = extra_note
     return {
-        "metric": "sim_jobs_per_sec_1M_jobs_4k_clusters",
+        "metric": metric,
         "value": round(jobs_per_sec, 1),
         "unit": "jobs/s",
         "vs_baseline": round(jobs_per_sec / (1_000_000 / 60.0), 3),
-        "detail": {"jobs": placed, "clusters": C, "wall_s": round(wall_s, 3),
-                   "compile_s": round(compile_s, 1), "ticks": n_ticks,
-                   "sim_horizon_s": n_ticks, "drops": drops,
-                   "speedup_vs_wallclock_reference": round(n_ticks / wall_s, 1)},
+        "detail": detail,
     }
+
+
+def bench_headline(quick=False):
+    """North star: 1M+ jobs x 4096 clusters, FIFO parity semantics."""
+    return _fifo_parity_scale(256 if quick else 4096, 250,
+                              "sim_jobs_per_sec_1M_jobs_4k_clusters")
 
 
 def bench_fifo_small():
@@ -357,8 +369,18 @@ def bench_borg4k(quick=False):
     }
 
 
+def bench_scale16k(quick=False):
+    """Headroom demonstration: 4x the north star — 4M jobs x 16,384
+    clusters, the exact headline setup at 4x the cluster count (~24 s
+    measured on a single chip; mesh-sharded when devices allow)."""
+    return _fifo_parity_scale(1024 if quick else 16384, 250,
+                              "sim_jobs_per_sec_4M_jobs_16k_clusters",
+                              repeats=2, extra_note="4x north-star scale")
+
+
 CONFIGS = {
     "headline": bench_headline,
+    "scale16k": bench_scale16k,
     "fifo_small": bench_fifo_small,
     "fifo_two_trader": bench_fifo_two_trader,
     "ffd64": bench_ffd64,
