@@ -1,0 +1,68 @@
+"""Seed-sweep parity fuzz: the bit-exactness claim must hold across
+workloads, not just the handful of fixed seeds the targeted parity tests
+use. Each case runs the engine and the Go-semantics oracle on a fresh
+seeded workload and requires identical placement traces and queue stats
+(PARITY.md). Kept small enough for CI (~1 min warm) but spanning every
+policy and the borrowing path."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig, WorkloadConfig
+from multi_cluster_simulator_tpu.core.engine import Engine
+from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.oracle.go_semantics import Oracle
+from multi_cluster_simulator_tpu.utils.trace import check_conservation
+from tests.conftest import make_arrivals
+from tests.test_parity import BASE, assert_stats_equal, assert_traces_equal
+
+N_TICKS = 150
+
+
+@pytest.mark.parametrize("policy,seed,lam", [
+    (PolicyKind.DELAY, 101, 20.0),
+    (PolicyKind.DELAY, 202, 50.0),
+    (PolicyKind.FIFO, 303, 20.0),
+    (PolicyKind.FIFO, 404, 50.0),
+    (PolicyKind.FFD, 505, 35.0),
+])
+def test_fuzz_single_cluster(small_spec, policy, seed, lam):
+    wl = WorkloadConfig(poisson_lambda_per_min=lam)
+    cfg = dataclasses.replace(BASE, policy=policy, workload=wl,
+                              queue_capacity=256)
+    arrivals = make_arrivals(cfg, 1, horizon_ms=N_TICKS * cfg.tick_ms,
+                             seed=seed)
+    state = Engine(cfg).run_jit()(init_state(cfg, [small_spec]),
+                                  arrivals, N_TICKS)
+    oracle = Oracle(cfg, [small_spec], arrivals).run(N_TICKS)
+    assert_traces_equal(state, oracle, 1)
+    assert_stats_equal(state, oracle, 1)
+    check_conservation(state)
+
+
+@pytest.mark.parametrize("seed", [606, 707])
+def test_fuzz_borrowing_three_clusters(seed):
+    """Asymmetric trio under load: one starved small cluster, two lenders.
+    The borrow broadcast/first-win determinization must agree with the
+    oracle whatever the arrival pattern."""
+    wl = WorkloadConfig(poisson_lambda_per_min=45.0)
+    cfg = dataclasses.replace(BASE, policy=PolicyKind.FIFO, borrowing=True,
+                              workload=wl, queue_capacity=256)
+    specs = [uniform_cluster(1, 2, cores=8, memory=4_000),
+             uniform_cluster(2, 5),
+             uniform_cluster(3, 10)]
+    arrivals = make_arrivals(cfg, 3, horizon_ms=N_TICKS * cfg.tick_ms,
+                             seed=seed, max_cores=16, max_mem=8_000)
+    # cluster 0 takes all the load; 1 and 2 lend
+    arrn = np.asarray(arrivals.n).copy()
+    arrn[1] = arrn[2] = 0
+    arrivals = arrivals.replace(n=arrn)
+    state = Engine(cfg).run_jit()(init_state(cfg, specs), arrivals, N_TICKS)
+    oracle = Oracle(cfg, specs, arrivals).run(N_TICKS)
+    assert any(e[3] == 4 for e in oracle.trace), "no lent placements fired"
+    assert_traces_equal(state, oracle, 3)
+    assert_stats_equal(state, oracle, 3)
+    check_conservation(state)
