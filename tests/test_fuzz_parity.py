@@ -10,14 +10,16 @@ import dataclasses
 import numpy as np
 import pytest
 
-from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig, WorkloadConfig
+from multi_cluster_simulator_tpu.config import PolicyKind, WorkloadConfig
 from multi_cluster_simulator_tpu.core.engine import Engine
 from multi_cluster_simulator_tpu.core.spec import uniform_cluster
 from multi_cluster_simulator_tpu.core.state import init_state
 from multi_cluster_simulator_tpu.oracle.go_semantics import Oracle
 from multi_cluster_simulator_tpu.utils.trace import check_conservation
 from tests.conftest import make_arrivals
-from tests.test_parity import BASE, assert_stats_equal, assert_traces_equal
+from tests.test_parity import (
+    BASE, assert_stats_equal, assert_traces_equal, run_both,
+)
 
 N_TICKS = 150
 
@@ -33,11 +35,7 @@ def test_fuzz_single_cluster(small_spec, policy, seed, lam):
     wl = WorkloadConfig(poisson_lambda_per_min=lam)
     cfg = dataclasses.replace(BASE, policy=policy, workload=wl,
                               queue_capacity=256)
-    arrivals = make_arrivals(cfg, 1, horizon_ms=N_TICKS * cfg.tick_ms,
-                             seed=seed)
-    state = Engine(cfg).run_jit()(init_state(cfg, [small_spec]),
-                                  arrivals, N_TICKS)
-    oracle = Oracle(cfg, [small_spec], arrivals).run(N_TICKS)
+    state, oracle, _ = run_both(cfg, [small_spec], N_TICKS, seed=seed)
     assert_traces_equal(state, oracle, 1)
     assert_stats_equal(state, oracle, 1)
     check_conservation(state)
