@@ -179,21 +179,25 @@ def _deliver_returns(state: SimState, rows, take, ex) -> SimState:
     dst_local = jnp.where(take, rows[..., R.ROWNER], -1)
     msg_dst = ex.gather(dst_local).reshape(-1)  # [C_tot*M]
     msg_rows = ex.gather(rows).reshape(-1, R.RF)
-    n_msgs = msg_dst.shape[0]
     gidx = ex.global_index(C_loc)
 
     def remove_for_cluster(borrowed_q, c):
-        def body(q, m):
-            row = msg_rows[m]
-            hit = jnp.logical_and(
-                jnp.logical_and(q.id == row[R.RID], q.cores == row[R.RCORES]),
-                jnp.logical_and(q.mem == row[R.RMEM], q.dur == row[R.RDUR]))
-            matched = jnp.logical_and(
-                jnp.logical_and(hit, msg_dst[m] == c), q.slot_valid())
-            return Q.compact(q, jnp.logical_not(matched)), None
-
-        q, _ = jax.lax.scan(body, borrowed_q, jnp.arange(n_msgs, dtype=jnp.int32))
-        return q
+        # One union mask over all messages, then ONE compact. Equivalent to
+        # applying the messages sequentially: each message removes every row
+        # equal to it on (id, cores, mem, dur) — field equality, not slot
+        # index — so the removed set is the union regardless of order, and a
+        # per-message scan-of-compacts (n_msgs argsorts per tick) is wasted
+        # work.
+        q = borrowed_q
+        hit = jnp.logical_and(
+            jnp.logical_and(q.id[None, :] == msg_rows[:, None, R.RID],
+                            q.cores[None, :] == msg_rows[:, None, R.RCORES]),
+            jnp.logical_and(q.mem[None, :] == msg_rows[:, None, R.RMEM],
+                            q.dur[None, :] == msg_rows[:, None, R.RDUR]))
+        matched = jnp.logical_and(
+            jnp.any(jnp.logical_and(hit, (msg_dst == c)[:, None]), axis=0),
+            q.slot_valid())
+        return Q.compact(q, jnp.logical_not(matched))
 
     borrowed = jax.vmap(remove_for_cluster)(state.borrowed, gidx)
     return state.replace(borrowed=borrowed)

@@ -81,29 +81,51 @@ def small_node_contract_asbuilt(l1: JobQueue, budget, core_cost, mem_cost) -> Co
     reset to 0 otherwise** (``jobState.time`` keeps its zero value when the
     new job doesn't extend the contract, scheduler_client.go:263-265).
     Budget stop as in fast-node. Preserved quirks and all — this is what the
-    reference actually requests."""
+    reference actually requests.
+
+    Vectorized, not a sequential fold: the cores/mem/gpu sums are cumsums,
+    and the time recurrence ``t_k = dur_k * [t_{k-1} < dur_k]`` is a
+    composition of one-threshold step functions ``t -> A*[t<theta] + B``,
+    a class closed under composition — so the whole trajectory is one
+    ``associative_scan`` (log-depth) instead of a Q-step serial scan, which
+    dominated the trade-round cost at large queue capacities. The budget
+    stop is a prefix property (the fold freezes at the first rejection), so
+    the accepted set is recoverable from the unstopped trajectories."""
     valid = l1.slot_valid()
+    cores = jnp.cumsum(jnp.where(valid, jnp.maximum(l1.cores, 0), 0))
+    mem = jnp.cumsum(jnp.where(valid, jnp.maximum(l1.mem, 0), 0))
+    gpu = jnp.cumsum(jnp.where(valid, jnp.maximum(l1.gpu, 0), 0))
 
-    def step(carry, i):
-        c, stopped = carry
-        v = jnp.logical_and(valid[i], jnp.logical_not(stopped))
-        nc = c.cores + jnp.where(l1.cores[i] > 0, l1.cores[i], 0)
-        nm = c.mem + jnp.where(l1.mem[i] > 0, l1.mem[i], 0)
-        ng = c.gpu + jnp.where(l1.gpu[i] > 0, l1.gpu[i], 0)
-        nt = jnp.where(l1.dur[i] > c.time_ms, l1.dur[i], jnp.int32(0))
-        np_ = _price(nc, nm, nt, core_cost, mem_cost)
-        accept = jnp.logical_and(v, jnp.logical_or(budget < 0, np_ < budget))
-        c = Contract(cores=jnp.where(accept, nc, c.cores),
-                     mem=jnp.where(accept, nm, c.mem),
-                     gpu=jnp.where(accept, ng, c.gpu),
-                     time_ms=jnp.where(accept, nt, c.time_ms),
-                     price=jnp.where(accept, np_, c.price))
-        stopped = jnp.logical_or(stopped, jnp.logical_and(v, jnp.logical_not(accept)))
-        return (c, stopped), None
+    # time trajectory: represent f_k(t) = dur_k * [t < dur_k] as the triple
+    # (theta, A, B) meaning t -> A*[t<theta] + B*[t>=theta]; composition
+    # keeps the leftmost threshold and maps both branch values, so the
+    # prefix compositions F_k are computed associatively and t_k = F_k(0).
+    dur = jnp.where(valid, l1.dur, 0)
 
-    (c, _), _ = jax.lax.scan(step, (Contract.zero(), jnp.zeros((), bool)),
-                             jnp.arange(l1.capacity, dtype=jnp.int32))
-    return c
+    def compose(a, b):  # apply a, then b
+        th_a, A_a, B_a = a
+        th_b, A_b, B_b = b
+        apply_b = lambda x: jnp.where(x < th_b, A_b, B_b)
+        return (th_a, apply_b(A_a), apply_b(B_a))
+
+    th, A, B = jax.lax.associative_scan(
+        compose, (dur, dur, jnp.zeros_like(dur)))
+    time_ms = jnp.where(0 < th, A, B)
+
+    price = _price(cores, mem, time_ms, core_cost, mem_cost)
+    ok = jnp.logical_and(valid, jnp.logical_or(budget < 0, price < budget))
+    # the fold stops at the first rejection: accepted = the ok-prefix of
+    # valid slots before the first valid-but-rejected index
+    reject = jnp.logical_and(valid, jnp.logical_not(ok))
+    stopped = jnp.cumsum(reject.astype(jnp.int32)) - reject.astype(jnp.int32) > 0
+    acc = jnp.logical_and(ok, jnp.logical_not(stopped))
+    k = jnp.sum(acc.astype(jnp.int32)) - 1
+    has = k >= 0
+    g = lambda a, z: jnp.where(has, a[jnp.maximum(k, 0)], z)
+    return Contract(cores=g(cores, jnp.int32(0)), mem=g(mem, jnp.int32(0)),
+                    gpu=g(gpu, jnp.int32(0)),
+                    time_ms=g(time_ms, jnp.int32(0)),
+                    price=g(price, jnp.float32(0.0)))
 
 
 def small_node_contract_sane(l1: JobQueue, budget, core_cost, mem_cost) -> Contract:

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Generate assets/borg2019_sample.jsonl.gz — a slice in the exact raw
+Borg-2019 ``instance_events`` schema (see workload/borg.py) with synthetic
+values. This offline image has zero egress, so no rows of the real
+clusterdata-2019 release can be vendored; this sample exists to exercise the
+full parse -> join -> replay path byte-identically to how a real slice
+would flow, and the bench labels its provenance honestly
+(bench.py borg_replay detail.trace_provenance).
+
+Value shapes follow the published characterizations of the 2019 trace
+(heavy-tailed normalized cpu/memory requests, lognormal task durations,
+diurnal submission intensity) without claiming to BE trace data.
+
+Deterministic: fixed seed, fixed gzip mtime. Regenerate with
+``python tools/make_borg_sample.py``.
+"""
+
+import gzip
+import json
+import os
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "assets", "borg2019_sample.jsonl.gz")
+
+N_COLLECTIONS = 6000
+MEAN_INSTANCES = 6  # geometric; real collections are heavy-tailed too
+SPAN_US = 6 * 3600 * 1_000_000  # six trace-hours
+
+
+def main():
+    rng = np.random.Generator(np.random.PCG64(2019))
+    rows = []
+    for coll in range(N_COLLECTIONS):
+        coll_id = 330_000_000_000 + coll * 1_009  # id shape like the release
+        n_inst = 1 + rng.geometric(1.0 / MEAN_INSTANCES)
+        # diurnal-ish submission: two gaussian bumps over the span
+        bump = rng.choice([0.3, 0.75], p=[0.6, 0.4])
+        t_sub0 = np.clip(rng.normal(bump, 0.18), 0.0, 0.98) * SPAN_US
+        cpus = float(np.clip(np.exp(rng.normal(-3.2, 1.1)), 1e-4, 1.0))
+        memn = float(np.clip(cpus * np.exp(rng.normal(0.1, 0.8)), 1e-5, 1.0))
+        for idx in range(int(n_inst)):
+            t_sub = int(t_sub0 + rng.exponential(2e6))
+            queue_us = int(rng.exponential(3e6))
+            dur_us = int(np.clip(np.exp(rng.normal(np.log(300e6), 1.4)),
+                                 5e6, SPAN_US))
+            sched = t_sub + queue_us
+            term = "FINISH" if rng.random() < 0.88 else \
+                ("KILL" if rng.random() < 0.7 else "EVICT")
+            rows.append({"time": t_sub, "type": "SUBMIT",
+                         "collection_id": coll_id, "instance_index": idx,
+                         "resource_request": {"cpus": round(cpus, 6),
+                                              "memory": round(memn, 6)}})
+            if rng.random() < 0.03:  # incomplete lifecycle (parser skips)
+                continue
+            rows.append({"time": sched, "type": "SCHEDULE",
+                         "collection_id": coll_id, "instance_index": idx})
+            rows.append({"time": sched + dur_us, "type": term,
+                         "collection_id": coll_id, "instance_index": idx})
+    rows.sort(key=lambda r: r["time"])
+    payload = "".join(json.dumps(r, separators=(",", ":")) + "\n" for r in rows)
+    with open(OUT, "wb") as raw:
+        with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0) as gz:
+            gz.write(payload.encode())
+    print(f"{OUT}: {len(rows)} events")
+
+
+if __name__ == "__main__":
+    main()
