@@ -110,6 +110,11 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     for _ in range(repeats):
         t0 = time.time()
         out, series = run(state, save=False)
+        # force a host read inside the timer: behind the device tunnel,
+        # block_until_ready has been observed returning early after a very
+        # long (>200 s) preceding compile call, which would record ~0 s
+        # walls for runs whose compute is still in flight
+        np.asarray(out.t)
         walls.append(time.time() - t0)
     info["walls"] = walls
     return out, min(walls), compile_s, series, info
@@ -118,13 +123,11 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
 def _timing_detail(info):
     """Timing methodology fields for a result's detail dict: the raw walls,
     the median, and the reported-min methodology label."""
-    walls = sorted(info.get("walls", []))
+    walls = info.get("walls", [])
     if not walls:
         return {}
-    med = walls[len(walls) // 2] if len(walls) % 2 else (
-        walls[len(walls) // 2 - 1] + walls[len(walls) // 2]) / 2
-    return {"walls": [round(w, 3) for w in info["walls"]],
-            "wall_median_s": round(med, 3),
+    return {"walls": [round(w, 3) for w in walls],
+            "wall_median_s": round(float(np.median(walls)), 3),
             "timing": f"min-of-{len(walls)}"}
 
 
@@ -189,7 +192,7 @@ def _fifo_parity_scale(C, jobs_per, metric, repeats=3, extra_note=None):
     if "wall_median_s" in timing:
         detail["median_jobs_per_sec"] = round(
             placed_here / max(timing["wall_median_s"], 1e-9), 1)
-        detail["min_over_median_spread"] = round(
+        detail["median_over_min_spread"] = round(
             timing["wall_median_s"] / max(wall_s, 1e-9), 3)
     if extra_note:
         detail["note"] = extra_note
@@ -330,25 +333,38 @@ def bench_ffd64(quick=False):
 
 
 def bench_sinkhorn(quick=False):
-    """Config 4: Sinkhorn trader matching, 1k clusters x 100k jobs, 3-dim
-    resources (cpu/mem/gpu). Clusters run hot (expected demand ~2x
-    capacity), so the utilization request-policy fires and the entropic-OT
-    matcher pairs overloaded buyers with idle sellers every monitor round."""
+    """Config 4: Sinkhorn trader matching, 3-dim resources (cpu/mem/gpu),
+    4096 clusters x 100 jobs (4x the 1k-cluster BASELINE shape — the
+    round-3 verdict asked for the market at headline cluster count; the
+    shard-local kernel keeps rows at [C_loc, C_tot] so this scales to the
+    16k mesh too). Clusters run hot (expected demand ~2x capacity), so the
+    utilization request-policy fires and the entropic-OT matcher pairs
+    overloaded buyers with idle sellers every monitor round."""
     from multi_cluster_simulator_tpu.config import (
         MatchKind, PolicyKind, SimConfig, TraderConfig,
     )
     from multi_cluster_simulator_tpu.core.spec import uniform_cluster
     from multi_cluster_simulator_tpu.workload.traces import uniform_stream
 
-    C, jobs_per = (64, 200) if quick else (1024, 100)
+    C, jobs_per = (64, 200) if quick else (4096, 100)
     horizon_ms = 600_000
     cfg = SimConfig(policy=PolicyKind.DELAY, parity=False,
-                    max_placements_per_tick=16,
+                    # 8 attempts/tick: placements here are capacity-bound
+                    # (~0.1 success/tick/cluster), so halving the sweep
+                    # budget costs no placements (placed_frac assert
+                    # guards) and halves the dominant per-tick cost
+                    max_placements_per_tick=8,
                     # quick's 2x-per-cluster load needs the deeper backlog
                     # ring (the zero-drops assert below is the guard)
                     queue_capacity=512 if quick else 128,
-                    max_running=256, max_arrivals=jobs_per,
-                    max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=2,
+                    # 128 run slots: measured peak concurrency is ~60/cluster
+                    # (durations <=300s over a 600s horizon); the run_full
+                    # drop counter guards the bound
+                    max_running=256 if quick else 128, max_arrivals=jobs_per,
+                    # Go appends virtual nodes unboundedly (cluster.go:79);
+                    # 4 slots covers the measured per-cluster win maximum
+                    # (the vslot drop counter is the guard)
+                    max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=4,
                     trader=TraderConfig(enabled=True,
                                         matching=MatchKind.SINKHORN,
                                         carve_mode="sane"))
@@ -375,7 +391,7 @@ def bench_sinkhorn(quick=False):
     assert frac >= floor, f"placed fraction {frac:.3f} < {floor} floor"
     rate = (placed - info["placed_before_resume"]) / max(wall_s, 1e-9)
     return {
-        "metric": "sinkhorn_market_jobs_per_sec_1kx100k_3res",
+        "metric": "sinkhorn_market_jobs_per_sec_4k_clusters_3res",
         "value": round(rate, 1),
         "unit": "jobs/s",
         "vs_baseline": round(rate / (1_000_000 / 60.0), 3),
@@ -543,6 +559,11 @@ def bench_borg_replay(quick=False):
         os.path.dirname(os.path.abspath(__file__)), "assets",
         "borg2019_sample.jsonl.gz")
     jobs = load_borg(path)
+    if len(jobs) < 48:
+        raise SystemExit(
+            f"borg_replay: {path} produced {len(jobs)} replayable jobs "
+            "(an instance needs a complete SUBMIT->SCHEDULE->terminal "
+            "lifecycle, or pre-joined rows) — not enough to replay")
     # cluster count scales with the trace: 4k clusters for a real slice,
     # fewer for the small vendored sample (>=48 jobs per cluster keeps the
     # replay meaningful); always a power of two for the virtual mesh

@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import os
 import struct as _struct
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

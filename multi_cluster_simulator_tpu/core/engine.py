@@ -97,21 +97,44 @@ def _attempt(s: SimState, job: Q.JobRec, t, do, src, record_trace: bool):
 
     A full running set makes the attempt fail (job stays queued) rather than
     leak resources — a documented divergence (PARITY.md): size
-    ``max_running`` so it never binds."""
+    ``max_running`` so it never binds.
+
+    One shared body with the sweep loops: a single-row deferred buffer
+    flushed immediately (start_many of one row == start), so placement
+    accounting can never drift between the head attempts and the sweeps."""
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
+    buf = jnp.zeros((1, R.RF), jnp.int32)
+    s, success, buf, cnt = _attempt_deferred(s, job, t, do, src, record_trace,
+                                             buf, jnp.int32(0), n_active)
+    return s.replace(run=R.start_many(s.run, buf, cnt)), success
+
+
+def _attempt_deferred(s: SimState, job: Q.JobRec, t, do, src,
+                      record_trace: bool, buf, cnt, n_active):
+    """``_attempt`` for placement-sweep loops: identical semantics, but the
+    RunningSet insertion is deferred — the placed row lands in ``buf`` at
+    position ``cnt`` (a [SW, RF] scratch, SW = sweep bound) and the caller
+    flushes the batch with ``R.start_many`` after the loop. The [S]-sized
+    set is then touched once per tick instead of once per sweep step, which
+    dominated the per-tick cost at thousands of clusters. ``n_active`` is
+    the set's occupancy at loop entry; ``n_active + cnt`` reproduces the
+    sequential has-slot check exactly."""
     node = P.first_fit(s.node_free, s.node_active, job)
-    has_slot = jnp.logical_not(jnp.all(s.run.active))
+    has_slot = (n_active + cnt) < s.run.capacity
     success = jnp.logical_and(jnp.logical_and(do, has_slot), node >= 0)
     free = P.occupy(s.node_free, node, job, success)
-    run = R.start(s.run, job, node, t, success)
+    row = R.row_from_job(job, node, t)
+    hot = jnp.logical_and(jnp.arange(buf.shape[0], dtype=jnp.int32) == cnt,
+                          success)
+    buf = jnp.where(hot[:, None], row, buf)
+    cnt = cnt + success.astype(jnp.int32)
     trace = _trace_append(s.trace, success, t, job.id, node, src) if record_trace else s.trace
-    # a feasible placement refused only by a full RunningSet is a divergence
-    # from Go (which has no such bound) — count it (SimState.drops)
     run_full = jnp.logical_and(jnp.logical_and(do, node >= 0),
                                jnp.logical_not(has_slot))
     drops = s.drops.replace(run_full=s.drops.run_full + run_full.astype(jnp.int32))
-    s = s.replace(node_free=free, run=run, trace=trace, drops=drops,
+    s = s.replace(node_free=free, trace=trace, drops=drops,
                   placed_total=s.placed_total + success.astype(jnp.int32))
-    return s, success
+    return s, success, buf, cnt
 
 
 def _sweep_len(cfg: SimConfig) -> int:
@@ -276,34 +299,45 @@ def _delay_local(s: SimState, t, cfg: SimConfig):
 
     # ---- Level1 sweep: a bounded while loop — under vmap it runs only
     # max-over-clusters(|Level1|) iterations, so an idle constellation pays
-    # ~nothing and parity mode costs the same as the capped fast mode ----
+    # ~nothing and parity mode costs the same as the capped fast mode.
+    # RunningSet insertions are deferred to one start_many after the loop
+    # (_attempt_deferred) — the per-step body touches only [SW]-sized
+    # scratch, not the [S]-sized set ----
     n_sweep = jnp.minimum(s.l1.count, QC)
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
 
     def cond(carry):
-        s2, i, rec, placed, skip_next = carry
+        s2, i, rec, placed, skip_next, buf, cnt = carry
         return i < n_sweep
 
     def step(carry):
-        s2, i, rec, placed, skip_next = carry
+        s2, i, rec, placed, skip_next, buf, cnt = carry
         process = jnp.logical_and(i < n_sweep, jnp.logical_not(skip_next))
-        job = Q.get(s2.l1, i).with_(rec_wait=rec[i])
-        total, new_rec = _record_wait(s2.wait_total, rec[i], job.enq_t, t, process)
-        rec = rec.at[i].set(jnp.where(process, new_rec, rec[i]))
+        # one-hot slot access: dynamic row gathers/scatters serialize when
+        # the loop body is vmapped over thousands of clusters
+        hot = jnp.arange(s2.l1.capacity, dtype=jnp.int32) == i
+        row = jnp.einsum("q,qf->f", hot.astype(jnp.int32), s2.l1.data)
+        rec_i = jnp.einsum("q,q->", hot.astype(jnp.int32), rec)
+        job = Q.JobRec(vec=row).with_(rec_wait=rec_i)
+        total, new_rec = _record_wait(s2.wait_total, rec_i, job.enq_t, t, process)
+        rec = jnp.where(jnp.logical_and(hot, process), new_rec, rec)
         s2 = s2.replace(wait_total=total)
-        s2, success = _attempt(s2, job, t, process, st.SRC_L1, cfg.record_trace)
+        s2, success, buf, cnt = _attempt_deferred(
+            s2, job, t, process, st.SRC_L1, cfg.record_trace, buf, cnt, n_active)
         s2 = s2.replace(jobs_in_queue=s2.jobs_in_queue - success.astype(jnp.int32))
-        placed = placed.at[i].set(jnp.where(process, success, placed[i]))
+        placed = jnp.logical_or(placed, jnp.logical_and(hot, success))
         # Parity: Go removes L1[i] in place and `i++` skips the element that
         # slides into position i (scheduler.go:319) — equivalent on the
         # original order to "after a success, skip the next element".
         skip_next = success if cfg.parity else jnp.zeros((), bool)
-        return (s2, i + 1, rec, placed, skip_next)
+        return (s2, i + 1, rec, placed, skip_next, buf, cnt)
 
     init = (s, jnp.int32(0), s.l1.rec_wait,
-            jnp.zeros((cfg.queue_capacity,), bool), jnp.zeros((), bool))
-    s, _, rec, placed, _ = jax.lax.while_loop(cond, step, init)
+            jnp.zeros((cfg.queue_capacity,), bool), jnp.zeros((), bool),
+            jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0))
+    s, _, rec, placed, _, buf, cnt = jax.lax.while_loop(cond, step, init)
     l1 = Q.compact(Q.set_col(s.l1, Q.FREC, rec), jnp.logical_not(placed))
-    s = s.replace(l1=l1)
+    s = s.replace(l1=l1, run=R.start_many(s.run, buf, cnt))
 
     # ---- Level0 head ----
     process = s.l0.count > 0
@@ -335,28 +369,38 @@ def _ffd_local(s: SimState, t, cfg: SimConfig):
         cfg.queue_capacity, cfg.max_placements_per_tick)
     order = P.best_fit_decreasing_order(s.l0.cores, s.l0.mem, s.l0.slot_valid())
     n_sweep = jnp.minimum(s.l0.count, QC)  # order puts valid slots first
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
 
     def cond(carry):
-        s2, k, placed = carry
+        s2, k, placed, buf, cnt = carry
         return k < n_sweep
 
     def step(carry):
-        s2, k, placed = carry
-        i = order[k]
+        s2, k, placed, buf, cnt = carry
         process = k < n_sweep
-        job = Q.get(s2.l0, i)
+        # one-hot slot access (see _delay_local): i = order[k], then row i
+        cap = s2.l0.capacity
+        hot_k = jnp.arange(cap, dtype=jnp.int32) == k
+        i = jnp.einsum("q,q->", hot_k.astype(jnp.int32), order)
+        hot = jnp.arange(cap, dtype=jnp.int32) == i
+        row = jnp.einsum("q,qf->f", hot.astype(jnp.int32), s2.l0.data)
+        job = Q.JobRec(vec=row)
         total, new_rec = _record_wait(s2.wait_total, job.rec_wait, job.enq_t, t, process)
+        frec = s2.l0.data[:, Q.FREC]
+        frec = jnp.where(jnp.logical_and(hot, process), new_rec, frec)
         s2 = s2.replace(wait_total=total,
-                        l0=s2.l0.replace(data=s2.l0.data.at[i, Q.FREC].set(
-                            jnp.where(process, new_rec, s2.l0.data[i, Q.FREC]))))
-        s2, success = _attempt(s2, job, t, process, st.SRC_L0, cfg.record_trace)
+                        l0=s2.l0.replace(data=s2.l0.data.at[:, Q.FREC].set(frec)))
+        s2, success, buf, cnt = _attempt_deferred(
+            s2, job, t, process, st.SRC_L0, cfg.record_trace, buf, cnt, n_active)
         s2 = s2.replace(jobs_in_queue=s2.jobs_in_queue - success.astype(jnp.int32))
-        placed = placed.at[i].set(jnp.where(process, success, placed[i]))
-        return (s2, k + 1, placed)
+        placed = jnp.logical_or(placed, jnp.logical_and(hot, success))
+        return (s2, k + 1, placed, buf, cnt)
 
-    s, _, placed = jax.lax.while_loop(
-        cond, step, (s, jnp.int32(0), jnp.zeros((cfg.queue_capacity,), bool)))
-    return s.replace(l0=Q.compact(s.l0, jnp.logical_not(placed)))
+    s, _, placed, buf, cnt = jax.lax.while_loop(
+        cond, step, (s, jnp.int32(0), jnp.zeros((cfg.queue_capacity,), bool),
+                     jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0)))
+    return s.replace(l0=Q.compact(s.l0, jnp.logical_not(placed)),
+                     run=R.start_many(s.run, buf, cnt))
 
 
 def _fifo_local(s: SimState, t, cfg: SimConfig):
@@ -374,32 +418,42 @@ def _fifo_local(s: SimState, t, cfg: SimConfig):
     # ---- ready drain (only when the wait queue is empty): place from the
     # head until the first failure; the failing job moves to WaitQueue.
     # Bounded while loop — exits as soon as every cluster drained/stopped ----
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
+
     def dcond(carry):
-        s2, i, stopped, n_taken, fail_job, any_fail = carry
+        s2, i, stopped, n_taken, fail_job, any_fail, buf, cnt = carry
         return jnp.logical_and(
             jnp.logical_not(wait_active),
             jnp.logical_and(i < jnp.minimum(s2.ready.count, QC),
                             jnp.logical_not(stopped)))
 
     def dstep(carry):
-        s2, i, stopped, n_taken, fail_job, any_fail = carry
+        s2, i, stopped, n_taken, fail_job, any_fail, buf, cnt = carry
         process = jnp.logical_and(
             jnp.logical_not(wait_active),
             jnp.logical_and(i < jnp.minimum(s2.ready.count, QC),
                             jnp.logical_not(stopped)))
-        job = Q.get(s2.ready, i)
-        s2, success = _attempt(s2, job, t, process, st.SRC_READY, cfg.record_trace)
+        hot = jnp.arange(s2.ready.capacity, dtype=jnp.int32) == i
+        job = Q.JobRec(vec=jnp.einsum("q,qf->f", hot.astype(jnp.int32),
+                                      s2.ready.data))
+        s2, success, buf, cnt = _attempt_deferred(
+            s2, job, t, process, st.SRC_READY, cfg.record_trace, buf, cnt,
+            n_active)
         fail = jnp.logical_and(process, jnp.logical_not(success))
         n_taken = n_taken + process.astype(jnp.int32)  # pops regardless of outcome
         fail_job = jax.tree.map(lambda a, b: jnp.where(fail, b, a), fail_job, job)
         return (s2, i + 1, jnp.logical_or(stopped, fail), n_taken, fail_job,
-                jnp.logical_or(any_fail, fail))
+                jnp.logical_or(any_fail, fail), buf, cnt)
 
     init = (s, jnp.int32(0), jnp.zeros((), bool), jnp.int32(0),
-            Q.JobRec.invalid(), jnp.zeros((), bool))
-    s, _, _, n_taken, fail_job, any_fail = jax.lax.while_loop(dcond, dstep, init)
-    # the drain consumes a strict prefix of the ready queue
-    s = s.replace(ready=Q.pop_front_n(s.ready, n_taken),
+            Q.JobRec.invalid(), jnp.zeros((), bool),
+            jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0))
+    s, _, _, n_taken, fail_job, any_fail, buf, cnt = jax.lax.while_loop(
+        dcond, dstep, init)
+    # the drain consumes a strict prefix of the ready queue; its placements
+    # flush into the set before the wait-head attempt reads occupancy
+    s = s.replace(run=R.start_many(s.run, buf, cnt),
+                  ready=Q.pop_front_n(s.ready, n_taken),
                   wait=Q.push_back(s.wait, fail_job, any_fail),
                   drops=s.drops.replace(
                       queue=s.drops.queue + Q.push_back_dropped(s.wait, any_fail)))
@@ -631,5 +685,6 @@ class Engine:
         return (state, series) if record else state
 
     def run_jit(self):
-        """A jitted (state, arrivals, n_ticks-static) -> state."""
+        """A jitted ``run``: (state, arrivals, n_ticks-static) -> state, or
+        (state, MetricSample series) when cfg.record_metrics is set."""
         return jax.jit(self.run, static_argnums=(2,))

@@ -49,24 +49,39 @@ class ClusterSpec:
     def total_memory(self) -> int:
         return sum(n.memory for n in self.nodes)
 
-    def to_json(self) -> dict:
-        """Serialize in the reference's Go-struct JSON shape (for /newClient)."""
+    def to_json(self, url: str = "") -> dict:
+        """Serialize in the reference's Go-struct JSON shape (for
+        /newClient): the full exported field set of Cluster/Node
+        (cluster.go:14-24,127-138) in struct order, so ``json.dumps(...,
+        separators=(",", ":"))`` is byte-identical to Go's json.Marshal of
+        a fresh cluster (nil RunningJobs map -> null, zero Durations -> 0).
+        ``Gpus`` (a 3-dim-resource extension with no Go analogue) is
+        appended only when nonzero — Go decoders ignore unknown fields, and
+        gpu-less specs stay byte-exact."""
+        nodes = []
+        for n in self.nodes:
+            d = {
+                "Id": n.id,
+                "Type": n.type,
+                "URL": "",
+                "Memory": n.memory,
+                "Cores": n.cores,
+                "MemoryAvailable": n.memory,
+                "CoresAvailable": n.cores,
+                "RunningJobs": None,
+                "Time": 0,
+            }
+            if n.gpus:
+                d["Gpus"] = n.gpus
+            nodes.append(d)
         return {
             "Id": self.id,
-            "Nodes": [
-                {
-                    "Id": n.id,
-                    "Type": n.type,
-                    "Memory": n.memory,
-                    "Cores": n.cores,
-                    "MemoryAvailable": n.memory,
-                    "CoresAvailable": n.cores,
-                    # extension field; absent from the Go struct and ignored
-                    # by Go decoders
-                    "Gpus": n.gpus,
-                }
-                for n in self.nodes
-            ],
+            "Nodes": nodes,
+            "URL": url,
+            "TotalMemory": self.total_memory,
+            "TotalCore": self.total_cores,
+            "MemoryUtilization": 0,
+            "CoreUtilization": 0,
         }
 
 

@@ -83,9 +83,14 @@ class Drops:
     vslot: jax.Array  # [C] i32 — trade won but no free virtual-node slot
     carve: jax.Array  # [C] i32 — carve planned on a node but no free
     #                      RunningSet slot for the Foreign placeholder
-    ingest: jax.Array  # [C] i32 — arrivals due this tick but deferred by the
-    #                      max_ingest_per_tick window (Go ingests all due
-    #                      arrivals at once; a binding window skews timing)
+    ingest: jax.Array  # [C] i32 — PER-TICK deferral events: +k each tick k
+    #                      due arrivals sit beyond the max_ingest_per_tick
+    #                      window, so one arrival deferred for 3 ticks
+    #                      counts 3 (unlike the other counters, which count
+    #                      jobs). Exact for the ==0 asserts; as a magnitude
+    #                      it is deferral-ticks, not jobs. (Go ingests all
+    #                      due arrivals at once; a binding window skews
+    #                      timing.)
 
 
 @struct.dataclass

@@ -137,9 +137,19 @@ def _match_sinkhorn(state: SimState, tr, t, mcfg, ex, gidx, g_buyer, g_con):
       has nothing to protect;
     - the gpu axis participates in capacity feasibility (3-dim resources).
 
-    Under sharding, rows (local sellers × all buyers) are local after the
-    buyer gather; the iteration state is replicated by gathering K, so every
-    shard computes the identical matching deterministically.
+    Sharding: every array stays row-sharded as (local sellers × all
+    buyers) — nothing [C_tot, C_tot] is ever replicated (a 16k-cluster
+    mesh would otherwise hold a 1 GB kernel per shard). The per-iteration
+    column reduction rides ``ex.allsum`` (deterministic fixed-order
+    combining) and the rounding's per-buyer argmax is an ``allmax`` of
+    column maxima + ``allmin`` of the best seller index. Decisions are
+    deterministic for a given mesh topology; across topologies the
+    cross-shard float-sum grouping can differ in the last ulp, which the
+    deterministic per-pair jitter (spaced ~eps/2 apart) keeps away from
+    decision boundaries — the sharded tests pin decision equality on the
+    8-device mesh (tests/test_sinkhorn.py::test_sinkhorn_sharded_equals_local).
+    On a single device the exchange ops are identities and this computes
+    exactly the replicated form.
     """
     C_loc = gidx.shape[0]
     C_tot = g_buyer.shape[0]
@@ -175,8 +185,7 @@ def _match_sinkhorn(state: SimState, tr, t, mcfg, ex, gidx, g_buyer, g_con):
                            jnp.logical_and(g_buyer[None, :],
                                            gidx[:, None] != bidx[None, :]))
 
-    # ---- replicate the full matrix and run Sinkhorn ----
-    feas_full = ex.gather(feas)  # [C_tot, C_tot]
+    # ---- shard-local kernel rows [s_loc, C_tot]; Sinkhorn iterations ----
     # buyer value: normalized resource volume (what a matched contract is
     # worth); sellers are symmetric, the iterations spread buyers across them
     v = (g_con.cores.astype(jnp.float32)
@@ -186,39 +195,45 @@ def _match_sinkhorn(state: SimState, tr, t, mcfg, ex, gidx, g_buyer, g_con):
     # deterministic per-pair jitter breaks exact ties (identical contracts
     # from several buyers would otherwise produce identical plan columns and
     # the argmax rounding would collapse every buyer onto one seller); kept
-    # well under the value scale so it only decides degenerate cases
-    sidx = jnp.arange(C_tot, dtype=jnp.float32)
+    # well under the value scale so it only decides degenerate cases.
+    # Rows index GLOBAL seller ids so every shard derives the same values.
+    sidx = gidx.astype(jnp.float32)
+    bfdx = jnp.arange(C_tot, dtype=jnp.float32)
     jitter = jnp.modf(jnp.sin(sidx[:, None] * 12.9898
-                              + sidx[None, :] * 78.233) * 43758.5453)[0]
+                              + bfdx[None, :] * 78.233) * 43758.5453)[0]
     eps = jnp.float32(mcfg.sinkhorn_eps)
     score = v[None, :] + jnp.abs(jitter) * (0.5 * eps)
-    K = jnp.where(feas_full, jnp.exp(score / eps), 0.0)
+    K = jnp.where(feas, jnp.exp(score / eps), 0.0)  # [s_loc, C_tot]
     tiny = jnp.float32(1e-30)
 
     def sink_step(uv, _):
-        u, vc = uv
+        u, vc = uv  # u: [s_loc] (my sellers), vc: [C_tot] (all buyers)
         u = 1.0 / jnp.maximum(K @ vc, tiny)
-        vc = 1.0 / jnp.maximum(K.T @ u, tiny)
+        vc = 1.0 / jnp.maximum(ex.allsum(K.T @ u), tiny)
         return (u, vc), None
 
     (u, vc), _ = jax.lax.scan(
-        sink_step, (jnp.ones((C_tot,), jnp.float32), jnp.ones((C_tot,), jnp.float32)),
+        sink_step, (jnp.ones((C_loc,), jnp.float32), jnp.ones((C_tot,), jnp.float32)),
         None, length=mcfg.sinkhorn_iters)
-    plan = u[:, None] * K * vc[None, :]  # [C_tot s, C_tot b]
+    plan = u[:, None] * K * vc[None, :]  # [s_loc, C_tot]
 
     # ---- round to a one-to-one matching: each buyer claims its argmax
-    # seller; each claimed seller keeps its highest-plan claimant ----
-    any_s = jnp.any(feas_full, axis=0)  # [b]
-    cand = jnp.where(any_s, jnp.argmax(plan, axis=0).astype(jnp.int32), INF)
-    claim = jnp.logical_and(cand[None, :] == jnp.arange(C_tot)[:, None],
-                            feas_full)  # [s, b]
+    # seller (lowest global index on ties — allmax of column maxima, then
+    # allmin over the sellers attaining it); each claimed seller keeps its
+    # highest-plan claimant ----
+    any_s = ex.allmax(jnp.any(feas, axis=0).astype(jnp.int32)) > 0  # [b]
+    colmax = ex.allmax(jnp.max(jnp.where(feas, plan, -1.0), axis=0))  # [b]
+    at_max = jnp.logical_and(feas, plan >= colmax[None, :])
+    cand = ex.allmin(jnp.min(jnp.where(at_max, gidx[:, None], INF), axis=0))
+    cand = jnp.where(any_s, cand, INF)
+    claim = jnp.logical_and(cand[None, :] == gidx[:, None], feas)  # [s_loc, b]
     best_b = jnp.argmax(jnp.where(claim, plan, -1.0), axis=1).astype(jnp.int32)
     seller_matched = jnp.any(claim, axis=1)
 
     # ---- local seller views + actual carve (sane mode is exactly the
     # cap_ok feasibility test, so carve_ok holds for every matched seller) ----
-    sel_b = best_b[gidx]  # my sellers' chosen buyers
-    win_sell = seller_matched[gidx]
+    sel_b = best_b  # my sellers' chosen buyers (rows are already local)
+    win_sell = seller_matched
     csel = _tree_take(g_con, sel_b)
     amounts, carve_ok = jax.vmap(
         lambda free, act, ccon: carve_ops.carve_plan(

@@ -104,6 +104,29 @@ def start(rs: RunningSet, job: JobRec, node: jax.Array, t: jax.Array, do: jax.Ar
     return RunningSet(data=data, active=active)
 
 
+def start_many(rs: RunningSet, rows: jax.Array, n_take: jax.Array) -> RunningSet:
+    """Batch-insert ``rows[:n_take]`` (insertion order) into the lowest
+    inactive slots, ascending — the exact slot layout a sequence of
+    ``start`` calls produces, at one [S, M] contraction instead of M
+    argmin+one-hot passes over the set. Callers guarantee
+    ``n_take <= free slots`` (the sweep's has-slot check).
+
+    This is what makes wide placement sweeps affordable at scale: the
+    per-iteration work inside the sweep loop shrinks to a row write into a
+    [M, RF] buffer, and the [S]-sized set is touched once per tick."""
+    # free_rank[s] = how many inactive slots precede s (valid where inactive)
+    inactive = jnp.logical_not(rs.active)
+    free_rank = jnp.cumsum(inactive.astype(jnp.int32)) - 1
+    M = rows.shape[0]
+    j = jnp.arange(M, dtype=jnp.int32)
+    hot = jnp.logical_and(
+        jnp.logical_and(free_rank[:, None] == j[None, :], inactive[:, None]),
+        (j < n_take)[None, :])  # [S, M]
+    written = jnp.any(hot, axis=1)
+    data = jnp.where(written[:, None], hot.astype(rows.dtype) @ rows, rs.data)
+    return RunningSet(data=data, active=jnp.logical_or(rs.active, written))
+
+
 def release(rs: RunningSet, free: jax.Array, t: jax.Array):
     """Complete all jobs with ``end_t <= t``: return their resources to
     ``free`` (RunJob's increment half, cluster.go:153-157) and clear slots.

@@ -10,6 +10,8 @@ code runs single-device (identity ops) or sharded over a device mesh
 
 - ``gather``     — see every cluster's request row   (lax.all_gather)
 - ``allmin``     — global minimum across shards       (lax.pmin)
+- ``allmax``     — global maximum across shards       (lax.pmax)
+- ``allsum``     — deterministic cross-shard sum      (all_gather + fixed-order sum)
 - ``offset``     — my shard's global cluster offset   (lax.axis_index)
 
 This is the idiomatic-TPU replacement for NCCL/MPI-style messaging: the
@@ -32,6 +34,12 @@ class Exchange:
     def allmin(self, x):
         raise NotImplementedError
 
+    def allmax(self, x):
+        raise NotImplementedError
+
+    def allsum(self, x):
+        raise NotImplementedError
+
     def offset(self, c_local: int):
         raise NotImplementedError
 
@@ -47,6 +55,12 @@ class LocalExchange(Exchange):
         return x
 
     def allmin(self, x):
+        return x
+
+    def allmax(self, x):
+        return x
+
+    def allsum(self, x):
         return x
 
     def offset(self, c_local: int):
@@ -66,6 +80,18 @@ class MeshExchange(Exchange):
 
     def allmin(self, x):
         return jax.lax.pmin(x, self.axis_name)
+
+    def allmax(self, x):
+        return jax.lax.pmax(x, self.axis_name)
+
+    def allsum(self, x):
+        """Cross-shard float sum with a deterministic combining order:
+        all_gather the per-shard partials, reduce the stacked [n_shards, ...]
+        axis in one fixed-order jnp.sum — psum's device combining tree is
+        backend-chosen, which would make the result topology-dependent in an
+        uncontrolled way."""
+        parts = jax.lax.all_gather(x, self.axis_name, axis=0, tiled=False)
+        return jnp.sum(parts, axis=0)
 
     def offset(self, c_local: int):
         return (jax.lax.axis_index(self.axis_name) * c_local).astype(jnp.int32)

@@ -63,8 +63,13 @@ class ServiceRegistration:
 
 
 def _patch(added=(), removed=()) -> dict:
-    return {"Added": [{"Name": n, "URL": u} for n, u in added],
-            "Removed": [{"Name": n, "URL": u} for n, u in removed]}
+    """The {Added, Removed} push shape (registration.go:19-27). Empty lists
+    marshal as null exactly like Go's nil slices, so the encoded patch is
+    byte-identical to the reference registry's pushes; every receiver
+    (ours at _handle_patch, Go's serviceUpdateHandler) treats null and []
+    the same."""
+    return {"Added": [{"Name": n, "URL": u} for n, u in added] or None,
+            "Removed": [{"Name": n, "URL": u} for n, u in removed] or None}
 
 
 class RegistryServer:
@@ -156,10 +161,10 @@ class RegistryServer:
         for reg in regs:
             if not reg.service_update_url:
                 continue
-            flt = {"Added": [e for e in patch["Added"]
-                             if e["Name"] in reg.required_services],
-                   "Removed": [e for e in patch["Removed"]
-                               if e["Name"] in reg.required_services]}
+            flt = {"Added": [e for e in patch["Added"] or []
+                             if e["Name"] in reg.required_services] or None,
+                   "Removed": [e for e in patch["Removed"] or []
+                               if e["Name"] in reg.required_services] or None}
             if flt["Added"] or flt["Removed"]:
                 threading.Thread(target=httpd.post_json,
                                  args=(reg.service_update_url, flt),

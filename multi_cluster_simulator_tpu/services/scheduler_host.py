@@ -46,12 +46,19 @@ from multi_cluster_simulator_tpu.services.lifecycle import Service
 from multi_cluster_simulator_tpu.services.registry import SERVICE_SCHEDULER
 
 
-# -- Go Job JSON wire format (scheduler.go:65-73; Duration is nanoseconds) --
+# -- Go Job JSON wire format (scheduler.go:65-73): struct field order,
+# Duration in int64 nanoseconds, State a StateType STRING (zero value ""),
+# WaitTime a time.Time (zero marshals as 0001-01-01T00:00:00Z) — pinned
+# byte-for-byte against Go's json.Marshal by tests/test_wire_fixtures.py --
 
-def job_to_json(id, cores, mem, dur_ms, ownership="") -> dict:
-    return {"Id": int(id), "CoresNeeded": int(cores),
-            "MemoryNeeded": int(mem), "State": 0,
-            "Duration": int(dur_ms) * 1_000_000, "Ownership": ownership}
+GO_ZERO_TIME = "0001-01-01T00:00:00Z"
+
+
+def job_to_json(id, cores, mem, dur_ms, ownership="", state="") -> dict:
+    return {"Id": int(id), "MemoryNeeded": int(mem),
+            "CoresNeeded": int(cores), "State": state,
+            "Duration": int(dur_ms) * 1_000_000,
+            "WaitTime": GO_ZERO_TIME, "Ownership": ownership}
 
 
 def job_from_json(d: dict) -> tuple[int, int, int, int, str]:
@@ -100,6 +107,10 @@ class SchedulerService(Service):
         # never blocks the HTTP surface)
         self._pending: list[tuple] = []
         self._plock = threading.Lock()
+        # mutation journal: a list while a tick's device call is in flight
+        # (handlers' state ops are replayed onto the tick result at swap
+        # time — see _mutate/_tick_once), None otherwise
+        self._journal: Optional[list] = None
         # borrower table: Ownership URL <-> owner index (>=1; 0 is this
         # cluster's own index in batch-engine semantics)
         self._owner_urls: list[str] = ["<self>"]
@@ -194,6 +205,29 @@ class SchedulerService(Service):
         self.meter.add("jobs_in_queue", 1)
         return 200, None
 
+    def _mutate(self, op, replay=None):
+        """Apply a state op (state -> (state', aux)) under the lock and
+        return aux. While a tick's device call is in flight (_tick_once
+        computes outside the lock), the op is also journaled and re-applied
+        onto the tick's output at swap time — the "handler ran just after
+        the tick" interleaving, which the reference's handlers race against
+        its scheduling goroutine the same way (server.go:80-137 vs
+        scheduler.go:298-369).
+
+        ``replay`` (state -> (state', aux)), when given, is what the
+        journal re-applies instead of ``op``: a decision the handler has
+        ALREADY acknowledged must not vanish silently if the tick consumed
+        the capacity it was based on — replay variants surface that as a
+        drop counter + error log instead (the Go analogue commits under
+        the node lock and the scheduler sees it afterwards; the one
+        remaining soft spot, commit_borrow's still-same-head gate, is the
+        first-200-wins race the reference also has)."""
+        with self._slock:
+            self.state, aux = op(self.state)
+            if self._journal is not None:
+                self._journal.append(replay or op)
+        return aux
+
     def _handle_borrow(self, body: bytes, headers: dict):
         """POST /borrow — a peer asks me to host a job: Lend() feasibility,
         then append to the LentQueue with the borrower's ownership
@@ -208,7 +242,19 @@ class SchedulerService(Service):
             owner = self._intern_owner(ownership)
             vec = Q.JobRec.make(id=jid, cores=cores, mem=mem, dur=dur_ms,
                                 enq_t=int(self.state.t), owner=owner).vec
-            self.state = host_ops.push_lent(self.state, vec)
+
+            def replay(s):
+                s2 = host_ops.push_lent(s, vec)
+                if int(np.asarray(s2.lent.count)[0]) == int(np.asarray(s.lent.count)[0]):
+                    # acked 200 but the post-tick LentQueue is full — surface
+                    self.logger.error(
+                        "replay: lent queue full, acked /borrow job %d dropped", jid)
+                    s2 = s2.replace(drops=s2.drops.replace(
+                        queue=s2.drops.queue + 1))
+                return s2, None
+
+            self._mutate(lambda s: (host_ops.push_lent(s, vec), None),
+                         replay=replay)
         self.logger.info("lent: accepted job %d from %s", jid, ownership)
         return 200, None
 
@@ -220,14 +266,13 @@ class SchedulerService(Service):
         except ValueError:
             return 400, None
         vec = Q.JobRec.make(id=jid, cores=cores, mem=mem, dur=dur_ms).vec
-        with self._slock:
-            self.state = host_ops.remove_borrowed(self.state, vec)
+        self._mutate(lambda s: (host_ops.remove_borrowed(s, vec), None))
         return 200, None
 
     def _handle_new_client(self, body: bytes, headers: dict):
         """GET /newClient — serialize my cluster for a joining workload
         client (server.go:139-153)."""
-        return 200, json.dumps(self.spec.to_json()).encode()
+        return 200, json.dumps(self.spec.to_json(url=self.url or "")).encode()
 
     # ------------------------------------------------------------------
     # arrival staging (the tensor form of the submit handlers)
@@ -373,12 +418,35 @@ class SchedulerService(Service):
                 self.logger.error("tick failed: %r", e)
 
     def _tick_once(self) -> None:
+        # Double-buffered: snapshot under the lock, run the jitted device
+        # call OUTSIDE it (it is the long pole — /borrow, /lent and the
+        # gRPC handlers must never stall a full tick on it), then swap,
+        # replaying any handler mutations that landed mid-tick (_mutate).
         with self._slock:
             self._drain_pending()
-            state, io = self._tick_fn(self.state, self._arrivals_device())
-            self.state = state
+            snap = self.state
+            arr = self._arrivals_device()
+            self._journal = []
+        try:
+            state, io = self._tick_fn(snap, arr)
             io = jax.tree.map(np.asarray, io)
-            t = int(np.asarray(state.t))
+        except Exception:
+            # journaled ops already live in self.state (the interim copy we
+            # keep by skipping the swap); disarm so the list can't grow
+            # unboundedly while the loop logs and retries
+            with self._slock:
+                self._journal = None
+            raise
+        with self._slock:
+            try:
+                for op in self._journal:
+                    state, _ = op(state)
+                self.state = state
+            finally:
+                # a replay failure keeps the interim self.state (ops were
+                # already applied to it) — the tick is lost, not the acks
+                self._journal = None
+            t = int(np.asarray(self.state.t))
         self.ticks_run += 1
         if (self.checkpoint_path is not None
                 and self.ticks_run % self.checkpoint_period_ticks == 0):
@@ -450,8 +518,7 @@ class SchedulerService(Service):
             for fut in as_completed(futs, timeout=10):
                 status, _ = fut.result()
                 if status == 200:
-                    with self._slock:
-                        self.state = host_ops.commit_borrow(self.state, vec)
+                    self._mutate(lambda s: (host_ops.commit_borrow(s, vec), None))
                     self.logger.info("borrowed: job %d hosted by %s",
                                      int(job.id), futs[fut])
                     break
@@ -488,25 +555,43 @@ class SchedulerService(Service):
 
     def provide_virtual_node(self, cores: int, mem: int, dur_ms: int) -> bool:
         """Lender-side carve (ProvideVirtualNode -> cluster.go:87-125)."""
-        with self._slock:
-            state, ok = host_ops.carve_occupy(
-                self.state, cores, mem, dur_ms,
-                mode=self.cfg.trader.carve_mode)
+        def op(s):
+            s2, ok = host_ops.carve_occupy(
+                s, cores, mem, dur_ms, mode=self.cfg.trader.carve_mode)
             ok = bool(ok)
-            if ok:
-                self.state = state
-        return ok
+            return (s2 if ok else s), ok
+
+        def replay(s):
+            s2, ok = op(s)
+            if not ok:
+                # the carve was already acked to the buyer; the tick consumed
+                # the capacity it was based on — count it, don't lose it
+                self.logger.error(
+                    "replay: acked carve (%d cores, %d MB) no longer fits", cores, mem)
+                s2 = s2.replace(drops=s2.drops.replace(carve=s2.drops.carve + 1))
+            return s2, ok
+
+        return self._mutate(op, replay=replay)
 
     def receive_virtual_node(self, cores: int, mem: int, dur_ms: int) -> bool:
         """Borrower-side attach (ReceiveVirtualNode -> cluster.go:65-85)."""
-        with self._slock:
-            state, ok = host_ops.add_virtual_node(
-                self.state, cores, mem, dur_ms, vstart=self.cfg.max_nodes,
+        def op(s):
+            s2, ok = host_ops.add_virtual_node(
+                s, cores, mem, dur_ms, vstart=self.cfg.max_nodes,
                 expire=self.cfg.trader.expire_virtual_nodes)
             ok = bool(ok)
-            if ok:
-                self.state = state
-        return ok
+            return (s2 if ok else s), ok
+
+        def replay(s):
+            s2, ok = op(s)
+            if not ok:
+                self.logger.error(
+                    "replay: acked virtual node (%d cores, %d MB) lost its slot",
+                    cores, mem)
+                s2 = s2.replace(drops=s2.drops.replace(vslot=s2.drops.vslot + 1))
+            return s2, ok
+
+        return self._mutate(op, replay=replay)
 
     # -- introspection for tests/operators --
     def stats(self) -> dict:

@@ -344,3 +344,44 @@ def test_full_constellation(tmp_path, registry):
         assert dest.exists() and dest.read_text(), "log sink stayed empty"
     finally:
         sink.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scheduler host: handlers never block on the in-flight tick device call
+# ---------------------------------------------------------------------------
+
+def test_handlers_do_not_block_on_tick_compute():
+    """The tick's jitted device call runs outside the state lock
+    (double-buffered swap + mutation-journal replay, _tick_once/_mutate):
+    a /borrow arriving mid-tick must answer immediately and its LentQueue
+    push must survive the post-tick state swap."""
+    import threading
+
+    s = SchedulerService("svc-noblock", uniform_cluster(1, 5), small_cfg())
+    # warm the handler-path host ops and the tick executable so the timed
+    # request measures lock contention, not XLA compiles
+    warm = json.dumps(job_to_json(1, 2, 500, 10_000,
+                                  ownership="http://peer:1")).encode()
+    assert s._handle_borrow(warm, {})[0] == 200
+    s._tick_once()
+
+    orig = s._tick_fn
+
+    def slow_tick(state, arr):
+        time.sleep(0.8)
+        return orig(state, arr)
+
+    s._tick_fn = slow_tick
+    th = threading.Thread(target=s._tick_once)
+    th.start()
+    time.sleep(0.2)  # the device call is now in flight, lock released
+    body = json.dumps(job_to_json(2, 2, 500, 10_000,
+                                  ownership="http://peer:1")).encode()
+    t0 = time.time()
+    status, _ = s._handle_borrow(body, {})
+    dt = time.time() - t0
+    th.join()
+    assert status == 200
+    assert dt < 0.4, f"handler stalled {dt:.2f}s behind the in-flight tick"
+    # the journaled mutation was replayed onto the tick's output
+    assert s.stats()["lent"] == 2

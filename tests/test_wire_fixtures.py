@@ -1,0 +1,207 @@
+"""Golden wire fixtures: the claim "a Go client/peer of the reference can
+talk to this service unchanged" pinned with bytes, not prose.
+
+No Go toolchain exists in this image, so each fixture is hand-derived from
+the Go marshaling rules against the reference's struct/proto definitions
+(cited per fixture): encoding/json marshals exported fields in struct
+order with no whitespace, nil slices/maps as null, time.Duration as int64
+nanoseconds, zero time.Time as "0001-01-01T00:00:00Z"; protobuf wire bytes
+follow the field numbers/types of pkg/trader/proto/*.proto (varint, fixed32
+float, fixed64 double, length-delimited submessages, proto3 implicit-zero
+and explicit-optional presence rules).
+
+Encoders must match the fixture BYTE-FOR-BYTE; decoders must accept the
+fixture bytes as a Go peer would emit them.
+"""
+
+import json
+
+from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+from multi_cluster_simulator_tpu.services.proto import resource_channel_pb2, trader_pb2
+from multi_cluster_simulator_tpu.services.registry import (
+    ServiceRegistration, _patch,
+)
+from multi_cluster_simulator_tpu.services.scheduler_host import (
+    job_from_json, job_to_json,
+)
+
+
+def go_json(obj) -> bytes:
+    """json.dumps in Go's encoding/json output form: no whitespace, and
+    insertion order == struct order (our encoders emit Go struct order)."""
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+# ---------------------------------------------------------------------------
+# Go Job JSON (scheduler.go:65-73) — the /delay, /, /borrow, /lent body
+# ---------------------------------------------------------------------------
+
+GO_JOB = (b'{"Id":7,"MemoryNeeded":2048,"CoresNeeded":4,"State":"",'
+          b'"Duration":30000000000,"WaitTime":"0001-01-01T00:00:00Z",'
+          b'"Ownership":"http://borrower:1"}')
+
+
+class TestJobJSON:
+    def test_encode_matches_go_marshal(self):
+        got = go_json(job_to_json(7, 4, 2048, 30_000,
+                                  ownership="http://borrower:1"))
+        assert got == GO_JOB
+
+    def test_decode_go_bytes(self):
+        jid, cores, mem, dur_ms, owner = job_from_json(json.loads(GO_JOB))
+        assert (jid, cores, mem, dur_ms, owner) == (
+            7, 4, 2048, 30_000, "http://borrower:1")
+
+    def test_decode_tolerates_named_state(self):
+        # a Go sender may carry State "Ready" (scheduler.go:79-86)
+        d = json.loads(GO_JOB)
+        d["State"] = "Ready"
+        assert job_from_json(d)[0] == 7
+
+
+# ---------------------------------------------------------------------------
+# Cluster /newClient payload (cluster.go:14-24,127-138; served at
+# server.go:139-153) — what a joining Go workload client decodes
+# ---------------------------------------------------------------------------
+
+GO_CLUSTER = (
+    b'{"Id":1,"Nodes":['
+    b'{"Id":1,"Type":"physical","URL":"","Memory":24000,"Cores":32,'
+    b'"MemoryAvailable":24000,"CoresAvailable":32,"RunningJobs":null,"Time":0},'
+    b'{"Id":2,"Type":"physical","URL":"","Memory":24000,"Cores":32,'
+    b'"MemoryAvailable":24000,"CoresAvailable":32,"RunningJobs":null,"Time":0}'
+    b'],"URL":"http://sched:1","TotalMemory":48000,"TotalCore":64,'
+    b'"MemoryUtilization":0,"CoreUtilization":0}')
+
+
+class TestClusterJSON:
+    def test_encode_matches_go_marshal(self):
+        spec = uniform_cluster(1, 2)
+        assert go_json(spec.to_json(url="http://sched:1")) == GO_CLUSTER
+
+    def test_decode_go_bytes(self):
+        from multi_cluster_simulator_tpu.core.spec import cluster_from_json
+        spec = cluster_from_json(json.loads(GO_CLUSTER))
+        assert spec.id == 1 and len(spec.nodes) == 2
+        assert spec.nodes[1].cores == 32 and spec.nodes[1].memory == 24000
+
+
+# ---------------------------------------------------------------------------
+# Registration + patch push (registration.go:3-27; POST /services body and
+# the ServiceUpdateURL pushes)
+# ---------------------------------------------------------------------------
+
+GO_REGISTRATION = (
+    b'{"ServiceName":"Scheduler","ServiceURL":"http://s:1",'
+    b'"RequiredServices":["Scheduler"],"ServiceUpdateURL":"http://s:1/services",'
+    b'"HeartbeatURL":"http://s:1/heartbeat"}')
+
+# an add-notification: Go leaves Removed nil -> null (server.go:23-76)
+GO_PATCH_ADD = (b'{"Added":[{"Name":"Scheduler","URL":"http://s:1"}],'
+                b'"Removed":null}')
+GO_PATCH_REMOVE = (b'{"Added":null,'
+                   b'"Removed":[{"Name":"Trader","URL":"http://t:1"}]}')
+
+
+class TestRegistryJSON:
+    def test_registration_encode(self):
+        reg = ServiceRegistration(
+            service_name="Scheduler", service_url="http://s:1",
+            required_services=["Scheduler"],
+            service_update_url="http://s:1/services",
+            heartbeat_url="http://s:1/heartbeat")
+        assert go_json(reg.to_json()) == GO_REGISTRATION
+
+    def test_registration_decode(self):
+        reg = ServiceRegistration.from_json(json.loads(GO_REGISTRATION))
+        assert reg.service_name == "Scheduler"
+        assert reg.required_services == ["Scheduler"]
+
+    def test_patch_encode(self):
+        assert go_json(_patch(added=[("Scheduler", "http://s:1")])) == GO_PATCH_ADD
+        assert go_json(_patch(removed=[("Trader", "http://t:1")])) == GO_PATCH_REMOVE
+
+    def test_patch_decode_tolerates_go_null(self):
+        """A Go registry's removal push carries Added:null — the client
+        patch handler must not trip on it (registry.go client.go:118-136)."""
+        from multi_cluster_simulator_tpu.services.registry import RegistryClient
+        c = RegistryClient.__new__(RegistryClient)
+        import threading
+        c._lock = threading.Lock()
+        c._providers = {"Trader": ["http://t:1"]}
+        c.logger = None
+        c.on_update = None
+        status, _ = c._handle_patch(GO_PATCH_REMOVE, {})
+        assert status == 200
+        assert c._providers["Trader"] == []
+        status, _ = c._handle_patch(GO_PATCH_ADD, {})
+        assert status == 200
+        assert c._providers["Scheduler"] == ["http://s:1"]
+
+
+# ---------------------------------------------------------------------------
+# Protobuf wire bytes (pkg/trader/proto/trader.proto:21-28,
+# resource-channel.proto:27-34) — hand-assembled per the protobuf wire
+# format: tag = (field_number << 3) | wire_type
+# ---------------------------------------------------------------------------
+
+# ContractRequest{id:7, cores:4, memory:2048, time:600s, price:12.5,
+#                 trader:"http://t:1"}
+CONTRACT_REQUEST = bytes([
+    0x08, 0x07,              # 1 id      varint 7
+    0x10, 0x04,              # 2 cores   varint 4
+    0x18, 0x80, 0x10,        # 3 memory  varint 2048
+    0x22, 0x03,              # 4 time    len-3 Duration
+    0x08, 0xD8, 0x04,        #     seconds varint 600
+    0x2D, 0x00, 0x00, 0x48, 0x41,  # 5 price fixed32 12.5f (0x41480000 LE)
+]) + bytes([0x32, 0x0A]) + b"http://t:1"  # 6 trader len-10
+
+# ClusterState{cores_utilization:0.5, memory_utilization:0.25,
+#              total_cpu:160, total_memory:120000, average_wait_time:1.5}
+CLUSTER_STATE_FULL = bytes([
+    0x0D, 0x00, 0x00, 0x00, 0x3F,  # 1 fixed32 0.5f
+    0x15, 0x00, 0x00, 0x80, 0x3E,  # 2 fixed32 0.25f
+    0x18, 0xA0, 0x01,              # 3 varint 160
+    0x20, 0xC0, 0xA9, 0x07,        # 4 varint 120000
+    0x29, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0xF8, 0x3F,  # 5 double 1.5
+])
+
+# the delta form: optional totals absent entirely (explicit presence,
+# trader_server.go:24-47 sends them only on first/changed)
+CLUSTER_STATE_DELTA = bytes([
+    0x0D, 0x00, 0x00, 0x00, 0x3F,
+    0x15, 0x00, 0x00, 0x80, 0x3E,
+    0x29, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0xF8, 0x3F,
+])
+
+
+class TestProtoWire:
+    def test_contract_request_serialize(self):
+        m = trader_pb2.ContractRequest(id=7, cores=4, memory=2048,
+                                       price=12.5, trader="http://t:1")
+        m.time.seconds = 600
+        assert m.SerializeToString() == CONTRACT_REQUEST
+
+    def test_contract_request_parse(self):
+        m = trader_pb2.ContractRequest.FromString(CONTRACT_REQUEST)
+        assert (m.id, m.cores, m.memory, m.time.seconds, m.trader) == (
+            7, 4, 2048, 600, "http://t:1")
+        assert abs(m.price - 12.5) < 1e-6
+
+    def test_cluster_state_full(self):
+        m = resource_channel_pb2.ClusterState(
+            cores_utilization=0.5, memory_utilization=0.25,
+            total_cpu=160, total_memory=120_000, average_wait_time=1.5)
+        assert m.SerializeToString() == CLUSTER_STATE_FULL
+
+    def test_cluster_state_delta_omits_optionals(self):
+        m = resource_channel_pb2.ClusterState(
+            cores_utilization=0.5, memory_utilization=0.25,
+            average_wait_time=1.5)
+        assert m.SerializeToString() == CLUSTER_STATE_DELTA
+        back = resource_channel_pb2.ClusterState.FromString(CLUSTER_STATE_DELTA)
+        # explicit-optional presence: the trader's full-vs-delta dispatch
+        # (trader.go:71-108, scheduler_client.go:14-47) depends on this
+        assert not back.HasField("total_cpu")
+        full = resource_channel_pb2.ClusterState.FromString(CLUSTER_STATE_FULL)
+        assert full.HasField("total_cpu") and full.total_cpu == 160
