@@ -267,6 +267,10 @@ def _ingest_local(s: SimState, arr_rows: jax.Array, arr_n: jax.Array, t,
     # never bound (Drops.ingest)
     deferred = (jnp.sum(due) - n).astype(jnp.int32)
     s = s.replace(drops=s.drops.replace(ingest=s.drops.ingest + deferred))
+    # one-hot window extraction: a [K, A] contraction against the packed
+    # rows. Measured alternatives at 4k clusters: vmapped dynamic_slice
+    # lowers to a serializing gather (2x the whole tick); the int32 matmul
+    # is exact and the fastest form XLA offers here.
     hot = (a[None, :] == (s.arr_ptr + jnp.arange(K, dtype=jnp.int32))[:, None])
     rows = hot.astype(arr_rows.dtype) @ arr_rows  # [K, NF]
     valid = jnp.arange(K, dtype=jnp.int32) < n
