@@ -1,0 +1,87 @@
+"""Multi-host (DCN) scale-out: the cluster axis sharded across processes.
+
+The reference spans hosts by launching scheduler/trader OS processes
+anywhere and wiring them over HTTP/gRPC through the registry (SURVEY.md
+§2.9). The TPU-native equivalent is multi-controller JAX: every host runs
+this same program, ``jax.distributed.initialize`` forms the global device
+set (the registry-analogue coordinator), and the ONE ShardedEngine code
+path then runs with its mesh spanning hosts — per-cluster phases stay
+host-local, the three cross-cluster exchanges (borrow match, trade round,
+return delivery) ride the same collectives, now over ICI within a host and
+DCN between hosts. Nothing in engine/ or exchange.py changes: a multi-host
+mesh is just a bigger mesh.
+
+The only genuinely multi-host-specific piece is input placement: a global
+host-built array must be distributed shard-by-shard (each process owns only
+its addressable devices), which ``shard_inputs_global`` does via
+``jax.make_array_from_callback``. Every process builds the same global
+inputs deterministically (seeded workloads make this free), and each
+callback hands JAX the slice it asks for.
+
+Validated end-to-end by tests/test_multihost.py: two OS processes x 4
+virtual CPU devices form an 8-device global mesh, run the sharded engine,
+and the gathered per-cluster results are bit-identical to a single-process
+run of the same config.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+# NOTE: jax.distributed.initialize must run before anything initializes
+# the XLA backend — and importing this package does (module-level jnp
+# constants). A multi-process entrypoint must therefore call
+# ``jax.distributed.initialize(coordinator_address=..., num_processes=...,
+# process_id=...)`` after a bare ``import jax`` and only then import
+# multi_cluster_simulator_tpu (see tests/_multihost_worker.py). The
+# coordinator plays the role the registry plays for the live service
+# constellation: the well-known address every process meets at.
+
+
+def global_mesh(axis: str = "clusters") -> Mesh:
+    """A mesh over the GLOBAL device set (all processes' devices)."""
+    from multi_cluster_simulator_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(axis=axis)
+
+
+def _make_global(x, mesh: Mesh, spec: P):
+    x = np.asarray(x)
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(x.shape, sharding,
+                                        lambda idx: x[idx])
+
+
+def shard_inputs_global(sh, state, arrivals):
+    """Multi-process form of ShardedEngine.shard_inputs: every process
+    passes the same deterministically built global state/arrivals; each
+    contributes the shards its devices own."""
+    from multi_cluster_simulator_tpu.parallel.sharded_engine import (
+        _arr_specs, _expand_prefix, _state_specs,
+    )
+
+    n = sh.mesh.shape[sh.axis]
+    C = np.asarray(state.arr_ptr).shape[0]
+    if C % n != 0:
+        raise ValueError(f"clusters ({C}) must divide by mesh size ({n})")
+
+    def put(tree, prefix):
+        specs = _expand_prefix(prefix, tree)
+        leaves, treedef = jax.tree.flatten(tree)
+        return jax.tree.unflatten(
+            treedef, [_make_global(x, sh.mesh, s)
+                      for x, s in zip(leaves, specs)])
+
+    return (put(state, _state_specs(sh.axis)),
+            put(arrivals, _arr_specs(sh.axis)))
+
+
+def gather_to_host(x) -> np.ndarray:
+    """Fetch a (possibly cross-process) sharded array fully to every host —
+    the readback half of the DCN story (result collection)."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -1,0 +1,73 @@
+"""Worker process for tests/test_multihost.py — NOT a pytest module.
+
+Run as: python tests/_multihost_worker.py <coordinator> <process_id> <nprocs>
+with JAX_PLATFORMS=cpu and xla_force_host_platform_device_count set by the
+spawner. Every process builds the same global inputs, joins the distributed
+run, advances the sharded engine over the cross-process mesh, gathers the
+results, and compares them bit-for-bit against a single-process local run
+of the identical config.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    coordinator, pid, nprocs = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    # distributed init MUST precede any package import: the package builds
+    # jnp constants at import time, which initializes the XLA backend
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs
+
+    from multi_cluster_simulator_tpu.parallel import multihost
+
+    import numpy as np
+
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig, WorkloadConfig
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.parallel import ShardedEngine
+    from multi_cluster_simulator_tpu.workload.generator import generate_arrivals
+
+    cfg = SimConfig(policy=PolicyKind.FIFO, borrowing=True, queue_capacity=64,
+                    max_running=128, max_arrivals=512, max_nodes=12,
+                    workload=WorkloadConfig(poisson_lambda_per_min=30.0))
+    C = 8
+    specs = [uniform_cluster(c + 1, 10 if c % 4 == 3 else 3,
+                             cores=32 if c % 4 == 3 else 16,
+                             memory=24_000 if c % 4 == 3 else 8_000)
+             for c in range(C)]
+    arrivals = generate_arrivals(cfg.workload, C, cfg.max_arrivals, 90_000,
+                                 16, 8_000, seed=23)
+    state0 = init_state(cfg, specs)
+
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size == nprocs * len(jax.local_devices()), mesh
+    sh = ShardedEngine(cfg, mesh)
+    gstate, garr = multihost.shard_inputs_global(sh, state0, arrivals)
+    out = sh.run_fn(90)(gstate, garr)
+
+    placed = multihost.gather_to_host(out.placed_total)
+    jq = multihost.gather_to_host(out.jobs_in_queue)
+    borrowed = multihost.gather_to_host(out.borrowed.count)
+
+    # ground truth: the single-device local engine on the same inputs
+    local = jax.jit(Engine(cfg).run, static_argnums=(2,))(state0, arrivals, 90)
+    np.testing.assert_array_equal(placed, np.asarray(local.placed_total))
+    np.testing.assert_array_equal(jq, np.asarray(local.jobs_in_queue))
+    np.testing.assert_array_equal(borrowed, np.asarray(local.borrowed.count))
+    assert placed.sum() > 0, "run placed nothing — not a meaningful check"
+    print(f"MULTIHOST OK pid={pid} devices={mesh.devices.size} "
+          f"placed={int(placed.sum())} borrowed={int(borrowed.sum())}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

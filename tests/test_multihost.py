@@ -1,0 +1,49 @@
+"""Multi-host (DCN) scale-out: two OS processes, each with 4 virtual CPU
+devices, form one 8-device global mesh via jax.distributed and run the
+sharded engine across it — the multi-controller analogue of the reference
+spanning hosts with OS processes + HTTP/gRPC (SURVEY.md §2.9). Each worker
+independently verifies the gathered global result is bit-identical to a
+single-process run (tests/_multihost_worker.py)."""
+
+import os
+import subprocess
+import sys
+
+from tests.conftest import free_port
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+
+
+def test_two_process_mesh_matches_local(tmp_path):
+    coordinator = f"127.0.0.1:{free_port()}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # a worker must not inherit this suite's 8-device flag or TPU config
+    env.pop("JAX_PLATFORM_NAME", None)
+    # jax.distributed.initialize must run before ANY backend init: strip
+    # site dirs whose sitecustomize imports jax at interpreter start (the
+    # TPU tunnel plugin does)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "site" not in os.path.basename(p))
+    # stdout to files, not pipes: a worker blocked on a full pipe would
+    # stall the collective and take the whole mesh down with it
+    logs = [tmp_path / f"worker{i}.log" for i in range(2)]
+    handles = [open(l, "w") for l in logs]
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, coordinator, str(i), "2"],
+        stdout=handles[i], stderr=subprocess.STDOUT, text=True, env=env)
+        for i in range(2)]
+    try:
+        for p in procs:
+            p.wait(timeout=300)
+    finally:
+        for p in procs:
+            p.kill()
+        for h in handles:
+            h.close()
+    for i, p in enumerate(procs):
+        out = logs[i].read_text()
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert "MULTIHOST OK" in out, f"worker {i} missing OK:\n{out[-3000:]}"
