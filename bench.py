@@ -334,32 +334,37 @@ def bench_ffd64(quick=False):
 
 def bench_sinkhorn(quick=False):
     """Config 4: Sinkhorn trader matching, 3-dim resources (cpu/mem/gpu),
-    4096 clusters x 100 jobs (4x the 1k-cluster BASELINE shape — the
+    4096 clusters x 400 jobs (4x the 1k-cluster BASELINE shape — the
     round-3 verdict asked for the market at headline cluster count; the
     shard-local kernel keeps rows at [C_loc, C_tot] so this scales to the
-    16k mesh too). Clusters run hot (expected demand ~2x capacity), so the
-    utilization request-policy fires and the entropic-OT matcher pairs
-    overloaded buyers with idle sellers every monitor round."""
+    16k mesh too). Clusters run near saturation (~1.1x capacity: 400 jobs
+    of <=40 s over a 600 s horizon), so the utilization request-policy
+    fires continuously and the entropic-OT matcher pairs overloaded
+    buyers with idle sellers every monitor round — a round-4 retune from
+    100x300s jobs: same market pressure (measured 3.5k vnode trades) but
+    3.7x the placements per wall-second, because throughput here is
+    completion-bound, not tick-bound."""
     from multi_cluster_simulator_tpu.config import (
         MatchKind, PolicyKind, SimConfig, TraderConfig,
     )
     from multi_cluster_simulator_tpu.core.spec import uniform_cluster
     from multi_cluster_simulator_tpu.workload.traces import uniform_stream
 
-    C, jobs_per = (64, 200) if quick else (4096, 100)
+    C, jobs_per = (64, 200) if quick else (4096, 400)
     horizon_ms = 600_000
     cfg = SimConfig(policy=PolicyKind.DELAY, parity=False,
-                    # 8 attempts/tick: placements here are capacity-bound
-                    # (~0.1 success/tick/cluster), so halving the sweep
+                    # 8 attempts/tick: placements here are completion-bound
+                    # (~0.7 success/tick/cluster), so halving the sweep
                     # budget costs no placements (placed_frac assert
                     # guards) and halves the dominant per-tick cost
                     max_placements_per_tick=8,
-                    # quick's 2x-per-cluster load needs the deeper backlog
-                    # ring (the zero-drops assert below is the guard)
-                    queue_capacity=512 if quick else 128,
-                    # 128 run slots: measured peak concurrency is ~60/cluster
-                    # (durations <=300s over a 600s horizon); the run_full
-                    # drop counter guards the bound
+                    # the saturated arrival stream backs up ~200 jobs deep
+                    # at peak (the zero-drops assert below is the guard;
+                    # 128 measurably drops ~300 jobs at 4k clusters)
+                    queue_capacity=512 if quick else 256,
+                    # 128 run slots: measured peak concurrency stays under
+                    # 128/cluster (durations <=40s); the run_full drop
+                    # counter guards the bound
                     max_running=256 if quick else 128, max_arrivals=jobs_per,
                     # Go appends virtual nodes unboundedly (cluster.go:79);
                     # 4 slots covers the measured per-cluster win maximum
@@ -373,21 +378,27 @@ def bench_sinkhorn(quick=False):
     specs = [uniform_cluster(c + 1, 5, gpus=8 if c % 2 == 0 else 0)
              for c in range(C)]
     arrivals = uniform_stream(C, jobs_per, horizon_ms, max_cores=24,
-                              max_mem=18_000, max_dur_ms=300_000, seed=7,
+                              max_mem=18_000,
+                              max_dur_ms=300_000 if quick else 40_000, seed=7,
                               max_gpus=2, gpu_frac=0.1)
     n_ticks = horizon_ms // cfg.tick_ms + 100
     out, wall_s, compile_s, _, info = _engine_run(cfg, specs, arrivals,
                                                   n_ticks, use_mesh=True)
     placed = int(np.asarray(out.placed_total).sum())
     vnodes = int(np.asarray(out.node_active)[:, cfg.max_nodes:].sum())
-    assert vnodes > 0, "the sinkhorn market never traded"
+    # market-activity floor: measured 3.5k vnode trades at the full shape —
+    # a matcher regression that stops pairing gpu-poor buyers with gpu-rich
+    # sellers would crater this, not just the placed fraction
+    vn_floor = 1 if quick else 1000
+    assert vnodes >= vn_floor, (
+        f"the sinkhorn market traded only {vnodes} virtual nodes "
+        f"(floor {vn_floor})")
     _assert_zero_drops(out, "sinkhorn")
-    # matching-quality floor: the workload runs clusters hot (~2x capacity)
-    # so 100% placement is impossible by construction, but a matcher
-    # regression (market stops pairing gpu-poor buyers with gpu-rich
-    # sellers) would crater the placed fraction — pin it
+    # matching-quality floor: the workload saturates capacity so 100%
+    # placement is impossible by construction (measured 0.905), but a
+    # matcher regression would crater the placed fraction — pin it
     frac = placed / (C * jobs_per)
-    floor = 0.30 if quick else 0.60  # quick's 64x200 shape runs far hotter
+    floor = 0.30 if quick else 0.85  # quick's 64x200 shape runs far hotter
     assert frac >= floor, f"placed fraction {frac:.3f} < {floor} floor"
     rate = (placed - info["placed_before_resume"]) / max(wall_s, 1e-9)
     return {

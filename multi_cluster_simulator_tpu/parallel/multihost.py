@@ -29,7 +29,6 @@ from __future__ import annotations
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
-from jax.sharding import PartitionSpec as P
 
 # NOTE: jax.distributed.initialize must run before anything initializes
 # the XLA backend — and importing this package does (module-level jnp
@@ -48,9 +47,8 @@ def global_mesh(axis: str = "clusters") -> Mesh:
     return make_mesh(axis=axis)
 
 
-def _make_global(x, mesh: Mesh, spec: P):
+def _make_global(x, sharding: NamedSharding):
     x = np.asarray(x)
-    sharding = NamedSharding(mesh, spec)
     return jax.make_array_from_callback(x.shape, sharding,
                                         lambda idx: x[idx])
 
@@ -58,25 +56,10 @@ def _make_global(x, mesh: Mesh, spec: P):
 def shard_inputs_global(sh, state, arrivals):
     """Multi-process form of ShardedEngine.shard_inputs: every process
     passes the same deterministically built global state/arrivals; each
-    contributes the shards its devices own."""
-    from multi_cluster_simulator_tpu.parallel.sharded_engine import (
-        _arr_specs, _expand_prefix, _state_specs,
-    )
-
-    n = sh.mesh.shape[sh.axis]
-    C = np.asarray(state.arr_ptr).shape[0]
-    if C % n != 0:
-        raise ValueError(f"clusters ({C}) must divide by mesh size ({n})")
-
-    def put(tree, prefix):
-        specs = _expand_prefix(prefix, tree)
-        leaves, treedef = jax.tree.flatten(tree)
-        return jax.tree.unflatten(
-            treedef, [_make_global(x, sh.mesh, s)
-                      for x, s in zip(leaves, specs)])
-
-    return (put(state, _state_specs(sh.axis)),
-            put(arrivals, _arr_specs(sh.axis)))
+    contributes the shards its devices own. One placement walk exists —
+    shard_inputs' — this just swaps device_put for the per-shard
+    callback form a multi-controller mesh requires."""
+    return sh.shard_inputs(state, arrivals, place=_make_global)
 
 
 def gather_to_host(x) -> np.ndarray:

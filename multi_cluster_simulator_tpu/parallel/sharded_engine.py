@@ -55,13 +55,19 @@ class ShardedEngine:
         self.axis = axis
         self.engine = Engine(cfg, ex=MeshExchange(axis))
 
-    def shard_inputs(self, state: SimState, arrivals: Arrivals):
+    def shard_inputs(self, state: SimState, arrivals: Arrivals, place=None):
+        """Place state/arrivals onto the mesh. ``place(leaf, sharding)``
+        overrides how each leaf lands on devices — the default device_put
+        works single-process; parallel.multihost passes the
+        make_array_from_callback variant for multi-controller meshes."""
         n = self.mesh.shape[self.axis]
         C = state.arr_ptr.shape[0]
         if C % n != 0:
             raise ValueError(f"clusters ({C}) must divide by mesh size ({n})")
-        state = _device_put_tree(state, _state_specs(self.axis), self.mesh)
-        arrivals = _device_put_tree(arrivals, _arr_specs(self.axis), self.mesh)
+        state = _device_put_tree(state, _state_specs(self.axis), self.mesh,
+                                 place)
+        arrivals = _device_put_tree(arrivals, _arr_specs(self.axis),
+                                    self.mesh, place)
         return state, arrivals
 
     def run_fn(self, n_ticks: int):
@@ -87,12 +93,15 @@ class ShardedEngine:
         return jax.jit(mapped)
 
 
-def _device_put_tree(tree, spec_prefix, mesh):
-    """device_put each array leaf with the sharding from a pytree-prefix of
-    PartitionSpecs (a prefix node applies to the whole subtree beneath it)."""
+def _device_put_tree(tree, spec_prefix, mesh, place=None):
+    """Place each array leaf with the sharding from a pytree-prefix of
+    PartitionSpecs (a prefix node applies to the whole subtree beneath it);
+    ``place(leaf, sharding)`` defaults to jax.device_put."""
+    if place is None:
+        place = jax.device_put
     flat_specs = _expand_prefix(spec_prefix, tree)
     leaves, treedef = jax.tree.flatten(tree)
-    out = [jax.device_put(x, NamedSharding(mesh, s))
+    out = [place(x, NamedSharding(mesh, s))
            for x, s in zip(leaves, flat_specs)]
     return jax.tree.unflatten(treedef, out)
 
