@@ -64,8 +64,45 @@ def main():
     np.testing.assert_array_equal(jq, np.asarray(local.jobs_in_queue))
     np.testing.assert_array_equal(borrowed, np.asarray(local.borrowed.count))
     assert placed.sum() > 0, "run placed nothing — not a meaningful check"
+
+    # scenario 2: the trader market across the process boundary — the
+    # trade round's cross-cluster exchange (gather + allmin over the
+    # cluster axis) now rides DCN between the two processes. Overloaded
+    # odd clusters buy from idle even clusters.
+    from multi_cluster_simulator_tpu.config import TraderConfig
+
+    cfg2 = SimConfig(policy=PolicyKind.DELAY, record_trace=False,
+                     queue_capacity=128, max_running=128, max_arrivals=256,
+                     max_nodes=12, max_virtual_nodes=4,
+                     trader=TraderConfig(enabled=True),
+                     workload=WorkloadConfig(poisson_lambda_per_min=60.0))
+    specs2 = [uniform_cluster(c + 1, 10 if c % 2 == 0 else 3,
+                              cores=32 if c % 2 == 0 else 16,
+                              memory=24_000 if c % 2 == 0 else 8_000)
+              for c in range(C)]
+    arrivals2 = generate_arrivals(cfg2.workload, C, cfg2.max_arrivals,
+                                  120_000, 16, 8_000, seed=31)
+    n2 = np.asarray(arrivals2.n).copy()
+    n2[::2] = 0  # even clusters idle -> pure sellers
+    arrivals2 = arrivals2.replace(n=n2)
+    state2 = init_state(cfg2, specs2)
+    sh2 = ShardedEngine(cfg2, mesh)
+    g2, ga2 = multihost.shard_inputs_global(sh2, state2, arrivals2)
+    out2 = sh2.run_fn(120)(g2, ga2)
+    local2 = jax.jit(Engine(cfg2).run, static_argnums=(2,))(state2, arrivals2, 120)
+    placed2 = multihost.gather_to_host(out2.placed_total)
+    vnodes2 = multihost.gather_to_host(out2.node_active)[:, cfg2.max_nodes:]
+    cooldown2 = multihost.gather_to_host(out2.trader.cooldown_until)
+    np.testing.assert_array_equal(placed2, np.asarray(local2.placed_total))
+    np.testing.assert_array_equal(
+        vnodes2, np.asarray(local2.node_active)[:, cfg2.max_nodes:])
+    np.testing.assert_array_equal(cooldown2,
+                                  np.asarray(local2.trader.cooldown_until))
+    assert vnodes2.sum() > 0, "the market never traded across the mesh"
+
     print(f"MULTIHOST OK pid={pid} devices={mesh.devices.size} "
-          f"placed={int(placed.sum())} borrowed={int(borrowed.sum())}",
+          f"placed={int(placed.sum())} borrowed={int(borrowed.sum())} "
+          f"traded_vnodes={int(vnodes2.sum())}",
           flush=True)
 
 
