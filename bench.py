@@ -518,6 +518,15 @@ def bench_parity_tpu(quick=False):
         assert any(e[1] == 1 and e[3] == 4 for e in oracle.trace), \
             "parity_tpu[fifo_borrowing]: no lent placement at the lender"
 
+    def _idle_odd_clusters(arrivals):
+        n = np.asarray(arrivals.n).copy()
+        n[1::2] = 0  # odd (big) clusters idle -> pure lenders
+        return arrivals.replace(n=n)
+
+    def _borrow_fired_any(oracle, cfg):
+        lenders = {e[1] for e in oracle.trace if e[3] == 4}
+        assert lenders, "parity_tpu[fifo_borrowing_8c]: nobody lent"
+
     def _market_fired(oracle, cfg):
         assert any(cl.active[cfg.max_nodes] for cl in oracle.clusters), \
             "parity_tpu[market]: no virtual node was ever created"
@@ -551,6 +560,15 @@ def bench_parity_tpu(quick=False):
          [small], 13, 200, 32, 24_000, None, None),
         ("trader_market", market_cfg, borrow_specs, 21, 300, 16, 8_000,
          _idle_cluster_1, _market_fired),
+        # 8 clusters, alternating starved/big: borrowing at a multi-cluster
+        # shape (the C=2 scenario can hide order bugs in the peer fan-out's
+        # first-200-wins determinization, server.go:183-243)
+        ("fifo_borrowing_8c", dataclasses.replace(
+            base, policy=PolicyKind.FIFO, borrowing=True, workload=heavy,
+            queue_capacity=256),
+         [uniform_cluster(c + 1, 3, cores=16, memory=8_000) if c % 2 == 0
+          else uniform_cluster(c + 1, 10) for c in range(8)],
+         27, 300, 16, 8_000, _idle_odd_clusters, _borrow_fired_any),
     ]
     t0 = time.time()
     events = 0
