@@ -24,6 +24,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import collections
 import dataclasses
 import json
 import sys
@@ -502,30 +503,22 @@ def bench_parity_tpu(quick=False):
     overload = WorkloadConfig(poisson_lambda_per_min=60.0)
     borrow_specs = [uniform_cluster(1, 3, cores=16, memory=8_000),
                     uniform_cluster(2, 10)]
-    # overloaded small cluster 0 + idle big cluster 1: zeroing cluster 1's
-    # arrivals forces the cross-cluster path (borrow / trade) to fire
-    def _idle_cluster_1(arrivals):
-        n = np.asarray(arrivals.n).copy()
-        n[1] = 0
-        return arrivals.replace(n=n)
+    from multi_cluster_simulator_tpu.workload import silence_clusters
 
     market_cfg = dataclasses.replace(
         base, policy=PolicyKind.DELAY, workload=overload, queue_capacity=512,
         max_virtual_nodes=4, trader=TraderConfig(enabled=True))
 
+    def _lenders(oracle):
+        # src==4 marks a LentQueue placement at the lender
+        return {e[1] for e in oracle.trace if e[3] == 4}
+
     def _borrow_fired(oracle, cfg):
-        # src==4 marks a LentQueue placement at the lender (cluster 1)
-        assert any(e[1] == 1 and e[3] == 4 for e in oracle.trace), \
+        assert 1 in _lenders(oracle), \
             "parity_tpu[fifo_borrowing]: no lent placement at the lender"
 
-    def _idle_odd_clusters(arrivals):
-        n = np.asarray(arrivals.n).copy()
-        n[1::2] = 0  # odd (big) clusters idle -> pure lenders
-        return arrivals.replace(n=n)
-
     def _borrow_fired_any(oracle, cfg):
-        lenders = {e[1] for e in oracle.trace if e[3] == 4}
-        assert lenders, "parity_tpu[fifo_borrowing_8c]: nobody lent"
+        assert _lenders(oracle), "parity_tpu[fifo_borrowing_8c]: nobody lent"
 
     def _market_fired(oracle, cfg):
         assert any(cl.active[cfg.max_nodes] for cl in oracle.clusters), \
@@ -535,60 +528,73 @@ def bench_parity_tpu(quick=False):
 
     # horizons mirror tests/test_parity.py's (400 ticks at the reference
     # lambda, 300 under the heavy overload workloads — the bound-sizing the
-    # CPU suite already proves drop-free). Optional per-scenario fields:
-    # mutate(arrivals) reshapes the workload; require(oracle, cfg) asserts
-    # the scenario actually exercised its mechanism.
+    # CPU suite already proves drop-free). Optional fields: mutate(arrivals)
+    # reshapes the workload (silence_clusters idles chosen clusters so they
+    # can only lend/sell); require(oracle, cfg) asserts the scenario
+    # actually exercised its mechanism.
+    Scenario = collections.namedtuple(
+        "Scenario", "name cfg specs seed n_ticks max_cores max_mem "
+        "mutate require", defaults=(None, None))
     scenarios = [
-        ("delay_small", dataclasses.replace(base, policy=PolicyKind.DELAY),
-         [small], 9, 400, 32, 24_000, None, None),
-        ("delay_heavy", dataclasses.replace(base, policy=PolicyKind.DELAY,
-                                            workload=heavy, queue_capacity=256),
-         [small], 3, 300, 32, 24_000, None, None),
+        Scenario("delay_small",
+                 dataclasses.replace(base, policy=PolicyKind.DELAY),
+                 [small], 9, 400, 32, 24_000),
+        Scenario("delay_heavy",
+                 dataclasses.replace(base, policy=PolicyKind.DELAY,
+                                     workload=heavy, queue_capacity=256),
+                 [small], 3, 300, 32, 24_000),
         # small jobs at 40/min: nearly every arrival places inside the
         # horizon, so the bulk of the compared events come from here
-        ("delay_packed", dataclasses.replace(base, policy=PolicyKind.DELAY,
-                                             workload=heavy, queue_capacity=256),
-         [small], 17, 400, 8, 6_000, None, None),
-        ("fifo_small", dataclasses.replace(base, policy=PolicyKind.FIFO),
-         [small], 9, 400, 32, 24_000, None, None),
-        ("fifo_borrowing", dataclasses.replace(
+        Scenario("delay_packed",
+                 dataclasses.replace(base, policy=PolicyKind.DELAY,
+                                     workload=heavy, queue_capacity=256),
+                 [small], 17, 400, 8, 6_000),
+        Scenario("fifo_small",
+                 dataclasses.replace(base, policy=PolicyKind.FIFO),
+                 [small], 9, 400, 32, 24_000),
+        # overloaded small cluster 0 + idle big cluster 1: forces the
+        # cross-cluster path (borrow / trade) to fire
+        Scenario("fifo_borrowing", dataclasses.replace(
             base, policy=PolicyKind.FIFO, borrowing=True, workload=heavy,
             queue_capacity=256), borrow_specs, 7, 300, 16, 8_000,
-         _idle_cluster_1, _borrow_fired),
-        ("ffd", dataclasses.replace(base, policy=PolicyKind.FFD,
-                                    workload=heavy, queue_capacity=256),
-         [small], 13, 200, 32, 24_000, None, None),
-        ("trader_market", market_cfg, borrow_specs, 21, 300, 16, 8_000,
-         _idle_cluster_1, _market_fired),
-        # 8 clusters, alternating starved/big: borrowing at a multi-cluster
-        # shape (the C=2 scenario can hide order bugs in the peer fan-out's
-        # first-200-wins determinization, server.go:183-243)
-        ("fifo_borrowing_8c", dataclasses.replace(
+            lambda a: silence_clusters(a, 1), _borrow_fired),
+        Scenario("ffd",
+                 dataclasses.replace(base, policy=PolicyKind.FFD,
+                                     workload=heavy, queue_capacity=256),
+                 [small], 13, 200, 32, 24_000),
+        Scenario("trader_market", market_cfg, borrow_specs, 21, 300, 16,
+                 8_000, lambda a: silence_clusters(a, 1), _market_fired),
+        # 8 clusters, alternating starved/big (odd = big = pure lenders):
+        # borrowing at a multi-cluster shape (the C=2 scenario can hide
+        # order bugs in the peer fan-out's first-200-wins determinization,
+        # server.go:183-243)
+        Scenario("fifo_borrowing_8c", dataclasses.replace(
             base, policy=PolicyKind.FIFO, borrowing=True, workload=heavy,
             queue_capacity=256),
-         [uniform_cluster(c + 1, 3, cores=16, memory=8_000) if c % 2 == 0
-          else uniform_cluster(c + 1, 10) for c in range(8)],
-         27, 300, 16, 8_000, _idle_odd_clusters, _borrow_fired_any),
+            [uniform_cluster(c + 1, 3, cores=16, memory=8_000) if c % 2 == 0
+             else uniform_cluster(c + 1, 10) for c in range(8)],
+            27, 300, 16, 8_000,
+            lambda a: silence_clusters(a, slice(1, None, 2)),
+            _borrow_fired_any),
     ]
     t0 = time.time()
     events = 0
     ran_ticks = []
-    for (name, cfg, specs, seed, n_ticks, max_cores, max_mem,
-         mutate, require) in scenarios:
-        if quick:
-            n_ticks = 100
+    for sc in scenarios:
+        name, cfg, specs = sc.name, sc.cfg, sc.specs
+        n_ticks = 100 if quick else sc.n_ticks
         ran_ticks.append(n_ticks)
         arrivals = generate_arrivals(cfg.workload, len(specs), cfg.max_arrivals,
-                                     n_ticks * cfg.tick_ms, max_cores, max_mem,
-                                     seed=seed)
-        if mutate is not None:
-            arrivals = mutate(arrivals)
+                                     n_ticks * cfg.tick_ms, sc.max_cores,
+                                     sc.max_mem, seed=sc.seed)
+        if sc.mutate is not None:
+            arrivals = sc.mutate(arrivals)
         eng = Engine(cfg)
         state = eng.run_jit()(init_state(cfg, specs), arrivals, n_ticks)
         oracle = Oracle(cfg, list(specs), arrivals).run(n_ticks)
         assert_no_drops(state)
-        if require is not None and not quick:
-            require(oracle, cfg)
+        if sc.require is not None and not quick:
+            sc.require(oracle, cfg)
         got = extract_trace(state)
         want = oracle_trace_per_cluster(oracle, len(specs))
         for c in range(len(specs)):
@@ -606,7 +612,7 @@ def bench_parity_tpu(quick=False):
         "vs_baseline": 1.0,
         "detail": {"backend": jax.default_backend(),
                    "devices": len(jax.devices()),
-                   "scenarios": [s[0] for s in scenarios],
+                   "scenarios": [s.name for s in scenarios],
                    "ticks_per_scenario": ran_ticks,
                    "events_compared": events,
                    "wall_s": round(time.time() - t0, 3)},

@@ -1,3 +1,5 @@
-from multi_cluster_simulator_tpu.workload.generator import generate_arrivals
+from multi_cluster_simulator_tpu.workload.generator import (
+    generate_arrivals, silence_clusters,
+)
 
-__all__ = ["generate_arrivals"]
+__all__ = ["generate_arrivals", "silence_clusters"]

@@ -80,11 +80,11 @@ def main():
                               cores=32 if c % 2 == 0 else 16,
                               memory=24_000 if c % 2 == 0 else 8_000)
               for c in range(C)]
-    arrivals2 = generate_arrivals(cfg2.workload, C, cfg2.max_arrivals,
-                                  120_000, 16, 8_000, seed=31)
-    n2 = np.asarray(arrivals2.n).copy()
-    n2[::2] = 0  # even clusters idle -> pure sellers
-    arrivals2 = arrivals2.replace(n=n2)
+    from multi_cluster_simulator_tpu.workload import silence_clusters
+
+    arrivals2 = silence_clusters(  # even clusters idle -> pure sellers
+        generate_arrivals(cfg2.workload, C, cfg2.max_arrivals,
+                          120_000, 16, 8_000, seed=31), slice(0, None, 2))
     state2 = init_state(cfg2, specs2)
     sh2 = ShardedEngine(cfg2, mesh)
     g2, ga2 = multihost.shard_inputs_global(sh2, state2, arrivals2)

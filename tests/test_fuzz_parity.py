@@ -90,11 +90,11 @@ def test_fuzz_trader_market(seed, lam, carve):
         trader=TraderConfig(enabled=True, carve_mode=carve))
     specs = [uniform_cluster(1, 3, cores=16, memory=8_000),
              uniform_cluster(2, 10)]
-    arrivals = make_arrivals(cfg, 2, horizon_ms=300 * cfg.tick_ms,
-                             seed=seed, max_cores=16, max_mem=8_000)
-    arrn = np.asarray(arrivals.n).copy()
-    arrn[1] = 0
-    arrivals = arrivals.replace(n=arrn)
+    from multi_cluster_simulator_tpu.workload import silence_clusters
+
+    arrivals = silence_clusters(
+        make_arrivals(cfg, 2, horizon_ms=300 * cfg.tick_ms,
+                      seed=seed, max_cores=16, max_mem=8_000), 1)
     state = Engine(cfg).run_jit()(init_state(cfg, specs), arrivals, 300)
     oracle = Oracle(cfg, specs, arrivals).run(300)
     assert any(cl.active[cfg.max_nodes] for cl in oracle.clusters), \
