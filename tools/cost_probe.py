@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Per-tick cost decomposition for the bench configs' engine shapes.
+
+For each shape this lowers one ``Engine.tick`` through XLA, pulls the
+compiler's own cost model (``compiled.cost_analysis()``: flops, bytes
+accessed), measures the real per-tick wall by timing a jitted
+``lax.scan`` over N ticks, and derives the achieved HBM bandwidth. The
+point is the evidence behind the no-Pallas design decision (README):
+the tick is bandwidth/latency-bound small-integer work, not FLOPs —
+arithmetic intensity is far below the MXU knee, so custom kernels would
+be fighting the wrong bottleneck.
+
+Run on the TPU (the default backend): ``python tools/cost_probe.py``.
+Writes a table to stdout and JSON to tools/cost_probe.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def shapes():
+    from multi_cluster_simulator_tpu.config import (
+        MatchKind, PolicyKind, SimConfig, TraderConfig,
+    )
+
+    # (name, cfg, C, jobs_per, full_ticks) — jobs are scaled down by
+    # n_ticks/full_ticks so the probe's per-tick load density matches the
+    # bench config it models
+    yield "headline_fifo_4k", SimConfig(
+        policy=PolicyKind.FIFO, queue_capacity=24, max_running=32,
+        max_arrivals=250, max_ingest_per_tick=8, parity=True, n_res=2,
+        max_nodes=5, max_virtual_nodes=0), 4096, 250, 1570
+    yield "borg4k_ffd", SimConfig(
+        policy=PolicyKind.FFD, parity=False, max_placements_per_tick=16,
+        queue_capacity=32, max_running=96, max_arrivals=250,
+        max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0,
+        n_res=2), 4096, 250, 1600
+    yield "sinkhorn_market_4k", SimConfig(
+        policy=PolicyKind.DELAY, parity=False, max_placements_per_tick=8,
+        queue_capacity=256, max_running=128, max_arrivals=400,
+        max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=4,
+        trader=TraderConfig(enabled=True, matching=MatchKind.SINKHORN,
+                            carve_mode="sane")), 4096, 400, 700
+
+
+def probe(name, cfg, C, jobs_per, full_ticks, n_ticks=200):
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+    import dataclasses
+
+    jobs_probe = max(int(jobs_per * n_ticks / full_ticks), 8)
+    cfg = dataclasses.replace(cfg, max_arrivals=jobs_probe)
+    gpu_shape = cfg.n_res > 2
+    specs = [uniform_cluster(c + 1, 5,
+                             gpus=(8 if c % 2 == 0 else 0) if gpu_shape else 0)
+             for c in range(C)]
+    arr = uniform_stream(C, jobs_probe, n_ticks * cfg.tick_ms, max_cores=24,
+                         max_mem=18_000, max_dur_ms=60_000, seed=7,
+                         max_gpus=2 if cfg.n_res > 2 else 0,
+                         gpu_frac=0.1 if cfg.n_res > 2 else 0.0)
+    eng = Engine(cfg)
+    state = init_state(cfg, specs)
+
+    # compiler cost model for ONE tick (arrivals pre-packed once, exactly
+    # as the scan path does at engine.py run())
+    from multi_cluster_simulator_tpu.core.engine import pack_arrivals
+    packed = pack_arrivals(arr)
+
+    def one_tick(s):
+        return eng._tick(s, packed, emit_io=False)[0]
+
+    lowered = jax.jit(one_tick).lower(state)
+    cost = lowered.compile().cost_analysis()
+    if isinstance(cost, list):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", 0.0))
+    bytes_acc = float(cost.get("bytes accessed", 0.0))
+
+    # measured per-tick wall from the scanned run (amortizes dispatch)
+    f = eng.run_jit()
+    out = jax.block_until_ready(f(state, arr, n_ticks))
+    walls = []
+    for _ in range(3):
+        t0 = time.time()
+        out = jax.block_until_ready(f(state, arr, n_ticks))
+        walls.append(time.time() - t0)
+    per_tick_ms = min(walls) / n_ticks * 1e3
+    achieved_gbps = bytes_acc / (per_tick_ms / 1e3) / 1e9
+    intensity = flops / bytes_acc if bytes_acc else float("nan")
+    return {
+        "config": name, "clusters": C, "backend": jax.default_backend(),
+        "tick_flops": flops, "tick_bytes_accessed": bytes_acc,
+        "arithmetic_intensity_flops_per_byte": round(intensity, 4),
+        "measured_ms_per_tick": round(per_tick_ms, 3),
+        "achieved_GB_per_s": round(achieved_gbps, 1),
+        "placed": int(np.asarray(out.placed_total).sum()),
+    }
+
+
+def main():
+    rows = [probe(*s) for s in shapes()]
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "cost_probe.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=2)
+    hdr = ("config", "ms/tick", "GFLOP/tick", "MB/tick", "FLOP/byte",
+           "achieved GB/s")
+    print(f"{hdr[0]:<20}{hdr[1]:>9}{hdr[2]:>12}{hdr[3]:>10}{hdr[4]:>11}{hdr[5]:>15}")
+    for r in rows:
+        print(f"{r['config']:<20}{r['measured_ms_per_tick']:>9}"
+              f"{r['tick_flops'] / 1e9:>12.3f}"
+              f"{r['tick_bytes_accessed'] / 1e6:>10.1f}"
+              f"{r['arithmetic_intensity_flops_per_byte']:>11}"
+              f"{r['achieved_GB_per_s']:>15}")
+    print(f"# wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
