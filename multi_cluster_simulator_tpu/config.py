@@ -135,6 +135,13 @@ class SimConfig:
     # per-tick placement work at `max_placements_per_tick` for throughput.
     parity: bool = True
     max_placements_per_tick: int = 16
+    # Fast-mode FFD sweep form: "wave" places speculative batches per
+    # while_loop iteration (provably identical placements to "serial" —
+    # engine._ffd_wave_local docstring; tests/test_kernel_equiv.py pins
+    # it); "serial" keeps the one-job-per-iteration sweep. Parity mode
+    # always runs the serial sweep (its float wait accumulation order is
+    # part of bit-parity with the oracle).
+    ffd_sweep: str = "wave"
 
     # --- instrumentation ---
     record_trace: bool = False  # record per-placement events

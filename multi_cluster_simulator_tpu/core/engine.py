@@ -407,6 +407,151 @@ def _ffd_local(s: SimState, t, cfg: SimConfig):
                      run=R.start_many(s.run, buf, cnt))
 
 
+def _trace_append_many(tr, take, t, job_ids, nodes, src):
+    """Batch form of ``_trace_append``: append events for positions where
+    ``take``, in position order — bit-identical to appending them one by
+    one. One [K, cap] one-hot contraction instead of K cursor writes."""
+    cap = tr.t.shape[-1]
+    rank = jnp.cumsum(take.astype(jnp.int32)) - 1
+    idx = tr.n + rank
+    ok = jnp.logical_and(take, idx < cap)
+    hot = jnp.logical_and(
+        ok[:, None], idx[:, None] == jnp.arange(cap, dtype=jnp.int32)[None, :]
+    ).astype(jnp.int32)  # [K, cap]
+    untouched = hot.sum(axis=0) == 0  # [cap]
+
+    def w(a, vals):
+        return jnp.where(untouched, a, jnp.einsum("kc,k->c", hot,
+                                                  vals.astype(jnp.int32)))
+
+    src_v = jnp.full(take.shape, jnp.int32(src))
+    t_v = jnp.full(take.shape, jnp.asarray(t, jnp.int32))
+    return tr.replace(t=w(tr.t, t_v), job=w(tr.job, job_ids),
+                      node=w(tr.node, nodes), src=w(tr.src, src_v),
+                      n=tr.n + ok.sum().astype(jnp.int32))
+
+
+def _ffd_wave_local(s: SimState, t, cfg: SimConfig):
+    """``_ffd_local`` restructured as speculative placement waves — same
+    placements, a fraction of the serial steps.
+
+    Sequential first-fit has a loop-carried dependency (each placement
+    shrinks ``free`` for the next job), which on TPU costs one
+    latency-bound while_loop iteration per queued job, maxed over all
+    vmapped clusters (tools/cost_probe.json: the FFD sweep achieves less
+    than half the headline's HBM bandwidth). The wave form places many
+    jobs per iteration and is *provably identical* to the serial sweep:
+
+    each wave, every unresolved job computes its first-fit target under
+    the current ``free``; the accepted set is the longest prefix (in FFD
+    order) whose targets are pairwise distinct. For an accepted job,
+    earlier accepted jobs all placed on *other* nodes, and ``free`` only
+    ever shrinks — so nodes before its target stay infeasible and its
+    target is untouched: exactly the node the serial sweep would pick.
+    A job infeasible under the current ``free`` is infeasible forever
+    (monotonicity) and resolves as failed immediately; the first
+    same-node conflict defers itself and everything after it to the next
+    wave. The earliest unresolved job can never conflict, so every wave
+    makes progress and the loop runs at most ceil(backlog / distinct
+    targets) iterations instead of backlog.
+
+    Used in fast mode (``parity=False`` — the Go reference has no FFD, so
+    there is no Go-semantics constraint either way; ``ffd_sweep="serial"``
+    keeps the old path, and tests/test_kernel_equiv.py pins wave == serial
+    on trace, queue, and node state across seeds)."""
+    QC = min(cfg.queue_capacity, cfg.max_placements_per_tick)
+    cap_q = s.l0.capacity
+    order = P.best_fit_decreasing_order(s.l0.cores, s.l0.mem,
+                                        s.l0.slot_valid())[:QC]  # [QC]
+    n_sweep = jnp.minimum(s.l0.count, QC)
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
+    act0 = jnp.arange(QC, dtype=jnp.int32) < n_sweep
+
+    # ordered job rows: one [QC, Q] @ [Q, NF] integer contraction
+    sel = (order[:, None] ==
+           jnp.arange(cap_q, dtype=jnp.int32)[None, :]).astype(jnp.int32)
+    rows = jnp.einsum("kq,qf->kf", sel, s.l0.data)
+    jobs = Q.JobRec(vec=rows)
+
+    # wait accounting, vectorized at the slot level (every processed job is
+    # recorded exactly once per tick; fast mode has no serial-float-order
+    # constraint — parity mode keeps the serial sweep)
+    processed_slot = jnp.einsum("kq,k->q", sel, act0.astype(jnp.int32)) > 0
+    cur = (t - s.l0.data[:, Q.FENQ]).astype(jnp.int32)
+    frec = s.l0.data[:, Q.FREC]
+    delta = jnp.where(processed_slot, (cur - frec).astype(jnp.float32), 0.0)
+    l0 = Q.set_col(s.l0, Q.FREC, jnp.where(processed_slot, cur, frec))
+    s = s.replace(wait_total=s.wait_total + delta.sum(), l0=l0)
+
+    def cond(carry):
+        free, resolved, node_sel, cnt, run_full = carry
+        return jnp.any(jnp.logical_and(act0, jnp.logical_not(resolved)))
+
+    def step(carry):
+        free, resolved, node_sel, cnt, run_full = carry
+        active = jnp.logical_and(act0, jnp.logical_not(resolved))
+        feas = jax.vmap(lambda c, m, g: P.feasible(
+            free, s.node_active, c, m, g))(jobs.cores, jobs.mem, jobs.gpu)
+        feas = jnp.logical_and(feas, active[:, None])  # [QC, N]
+        feas_any = jnp.any(feas, axis=-1)
+        tgt = jnp.argmax(feas, axis=-1).astype(jnp.int32)  # first-fit node
+        tgt_hot = jnp.logical_and(
+            feas_any[:, None],
+            tgt[:, None] == jnp.arange(feas.shape[1],
+                                       dtype=jnp.int32)[None, :],
+        ).astype(jnp.int32)  # [QC, N], rows zero where infeasible/inactive
+        prior = jnp.cumsum(tgt_hot, axis=0) - tgt_hot
+        conflict = jnp.einsum("kn,kn->k", prior, tgt_hot) > 0
+        blocked = jnp.cumsum(conflict.astype(jnp.int32)) > 0  # self included
+        place_try = jnp.logical_and(feas_any, jnp.logical_not(blocked))
+        rank = jnp.cumsum(place_try.astype(jnp.int32)) - 1
+        has_slot = (n_active + cnt + rank) < s.run.capacity
+        place = jnp.logical_and(place_try, has_slot)
+        slot_full = jnp.logical_and(place_try, jnp.logical_not(has_slot))
+        # infeasible-now is infeasible-forever (free only shrinks): resolve
+        # failed even past the block point; slot-exhausted jobs resolve too
+        # (run_full drop), exactly as the serial sweep counts them
+        resolved = jnp.logical_or(
+            resolved, jnp.logical_or(
+                place, jnp.logical_or(
+                    slot_full,
+                    jnp.logical_and(active, jnp.logical_not(feas_any)))))
+        used = jnp.einsum("kn,kr->nr", tgt_hot * place[:, None].astype(jnp.int32),
+                          jobs.res[..., : free.shape[-1]])
+        free = free - used
+        node_sel = jnp.where(place, tgt, node_sel)
+        cnt = cnt + place.sum().astype(jnp.int32)
+        run_full = run_full + slot_full.sum().astype(jnp.int32)
+        return free, resolved, node_sel, cnt, run_full
+
+    free, _, node_sel, cnt, run_full = jax.lax.while_loop(
+        cond, step, (s.node_free, jnp.logical_not(act0),
+                     jnp.full((QC,), P.NO_NODE), jnp.int32(0), jnp.int32(0)))
+
+    placed_pos = node_sel >= jnp.int32(0)  # [QC], in FFD order
+    # runset rows in position order, compacted to the buffer prefix
+    all_rows = jax.vmap(lambda v, n: R.row_from_job(Q.JobRec(vec=v), n, t)
+                        )(rows, node_sel)
+    rankp = jnp.cumsum(placed_pos.astype(jnp.int32)) - 1
+    bhot = jnp.logical_and(
+        placed_pos[:, None],
+        rankp[:, None] == jnp.arange(QC, dtype=jnp.int32)[None, :],
+    ).astype(jnp.int32)  # [QC, QC]
+    buf = jnp.einsum("kb,kf->bf", bhot, all_rows)
+    trace = s.trace
+    if cfg.record_trace:
+        trace = _trace_append_many(trace, placed_pos, t, jobs.id, node_sel,
+                                   st.SRC_L0)
+    placed_slot = jnp.einsum("kq,k->q", sel, placed_pos.astype(jnp.int32)) > 0
+    return s.replace(
+        node_free=free, trace=trace,
+        drops=s.drops.replace(run_full=s.drops.run_full + run_full),
+        placed_total=s.placed_total + cnt,
+        jobs_in_queue=s.jobs_in_queue - cnt,
+        l0=Q.compact(s.l0, jnp.logical_not(placed_slot)),
+        run=R.start_many(s.run, buf, cnt))
+
+
 def _fifo_local(s: SimState, t, cfg: SimConfig):
     """Fifo() (scheduler.go:216-296) as ordered masked phases; see PARITY.md
     for the derivation of the per-tick semantics from the Go loop's
@@ -645,7 +790,10 @@ class Engine:
             state = jax.vmap(functools.partial(_delay_local, cfg=cfg),
                              in_axes=(_STATE_AXES, None), out_axes=_STATE_AXES)(state, t)
         elif cfg.policy == PolicyKind.FFD:
-            state = jax.vmap(functools.partial(_ffd_local, cfg=cfg),
+            ffd = (_ffd_wave_local
+                   if not cfg.parity and cfg.ffd_sweep == "wave"
+                   else _ffd_local)
+            state = jax.vmap(functools.partial(ffd, cfg=cfg),
                              in_axes=(_STATE_AXES, None), out_axes=_STATE_AXES)(state, t)
         else:  # FIFO
             state, want, bjobs = jax.vmap(

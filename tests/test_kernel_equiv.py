@@ -121,3 +121,65 @@ class TestSizingEquivalence:
         assert (int(got.cores), int(got.mem), int(got.gpu),
                 int(got.time_ms)) == want[:4]
         assert abs(float(got.price) - want[4]) <= 1e-3 * max(1.0, want[4])
+
+
+class TestFFDWaveSweep:
+    """engine._ffd_wave_local == engine._ffd_local (fast mode), end to end.
+
+    The wave sweep's equivalence argument (prefix-restricted speculative
+    acceptance; see its docstring) is pinned here across seeds and both
+    workload shapes, comparing full traces, queue contents, node state,
+    and every drop counter — including the run_full regime, where the
+    slot-rank bookkeeping must reproduce the serial sweep's drop counts
+    exactly."""
+
+    @pytest.mark.parametrize("seed,workload,running",
+                             [(1, "uniform", 48), (7, "borg", 48),
+                              (19, "uniform", 12), (23, "borg", 12)])
+    def test_wave_matches_serial(self, seed, workload, running):
+        import dataclasses
+
+        import multi_cluster_simulator_tpu as mcs
+        from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+        from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+        from multi_cluster_simulator_tpu.utils.trace import (
+            extract_trace, total_drops,
+        )
+        from multi_cluster_simulator_tpu.workload.traces import (
+            borg_like_stream, uniform_stream,
+        )
+
+        base = SimConfig(policy=PolicyKind.FFD, parity=False,
+                         max_placements_per_tick=16, queue_capacity=32,
+                         max_running=running, max_arrivals=120,
+                         max_ingest_per_tick=8, max_nodes=5,
+                         max_virtual_nodes=0, n_res=2, record_trace=True)
+        C, jobs_per, horizon = 8, 120, 200_000
+        kw = dict(max_cores=32, max_mem=24_000, seed=seed)
+        if workload == "uniform":
+            arr = uniform_stream(C, jobs_per, horizon, max_dur_ms=60_000, **kw)
+        else:
+            arr = borg_like_stream(C, jobs_per, horizon, **kw)
+        specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+        n_ticks = horizon // 1000 + 60
+        outs = {}
+        for mode in ("serial", "wave"):
+            cfg = dataclasses.replace(base, ffd_sweep=mode)
+            outs[mode] = mcs.Engine(cfg).run_jit()(
+                mcs.init_state(cfg, specs), arr, n_ticks)
+        a, b = outs["serial"], outs["wave"]
+        assert extract_trace(a) == extract_trace(b)
+        for f in ("node_free", "placed_total", "jobs_in_queue"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                err_msg=f)
+        np.testing.assert_array_equal(np.asarray(a.l0.data),
+                                      np.asarray(b.l0.data))
+        np.testing.assert_array_equal(np.asarray(a.l0.count),
+                                      np.asarray(b.l0.count))
+        # wave sums wait deltas in a tree, serial in job order: same value
+        # up to float32 reassociation, not bit-equal by design
+        np.testing.assert_allclose(np.asarray(a.wait_total),
+                                   np.asarray(b.wait_total), rtol=1e-6)
+        assert total_drops(a) == total_drops(b)
+        assert int(np.asarray(a.placed_total).sum()) > 0

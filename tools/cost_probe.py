@@ -39,11 +39,19 @@ def shapes():
         policy=PolicyKind.FIFO, queue_capacity=24, max_running=32,
         max_arrivals=250, max_ingest_per_tick=8, parity=True, n_res=2,
         max_nodes=5, max_virtual_nodes=0), 4096, 250, 1570
-    yield "borg4k_ffd", SimConfig(
+    # both FFD sweep forms, so the JSON keeps carrying the serial-vs-wave
+    # evidence the wave kernel's docstring cites (the serial row is the
+    # latency-bound baseline; wave is the shipping default)
+    yield "borg4k_ffd_serial", SimConfig(
         policy=PolicyKind.FFD, parity=False, max_placements_per_tick=16,
         queue_capacity=32, max_running=96, max_arrivals=250,
         max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0,
-        n_res=2), 4096, 250, 1600
+        n_res=2, ffd_sweep="serial"), 4096, 250, 1600
+    yield "borg4k_ffd_wave", SimConfig(
+        policy=PolicyKind.FFD, parity=False, max_placements_per_tick=16,
+        queue_capacity=32, max_running=96, max_arrivals=250,
+        max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0,
+        n_res=2, ffd_sweep="wave"), 4096, 250, 1600
     yield "sinkhorn_market_4k", SimConfig(
         policy=PolicyKind.DELAY, parity=False, max_placements_per_tick=8,
         queue_capacity=256, max_running=128, max_arrivals=400,
