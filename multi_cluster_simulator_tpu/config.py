@@ -142,6 +142,12 @@ class SimConfig:
     # always runs the serial sweep (its float wait accumulation order is
     # part of bit-parity with the oracle).
     ffd_sweep: str = "wave"
+    # FIFO ready-drain form: the wave version is exact in BOTH modes (the
+    # drain body has no order-sensitive float accumulation — see
+    # engine._fifo_drain_wave), so it is the default everywhere; "serial"
+    # keeps the one-job-per-iteration loop. The oracle parity suite and
+    # the TPU parity gate run the wave path and must stay bit-exact.
+    fifo_drain: str = "wave"
 
     # --- instrumentation ---
     record_trace: bool = False  # record per-placement events

@@ -552,6 +552,109 @@ def _ffd_wave_local(s: SimState, t, cfg: SimConfig):
         run=R.start_many(s.run, buf, cnt))
 
 
+def _fifo_drain_wave(s: SimState, t, cfg: SimConfig, wait_active, n_active,
+                     QC: int):
+    """The FIFO ready drain (place from the head until the first failure)
+    as speculative waves — same outcome as the serial loop in
+    ``_fifo_local``, a fraction of the while_loop iterations.
+
+    The equivalence argument mirrors ``_ffd_wave_local`` (prefix-restricted
+    acceptance; free only shrinks, so accepted first-fit targets and
+    observed infeasibilities are both stable), with one extra rule for the
+    drain-stops-at-first-failure semantics: each wave accepts candidates
+    only up to the first *breaker* — a conflict (defer to the next wave),
+    an infeasible job, or a run-slot-exhausted job (both of the latter ARE
+    the drain's failing job: it pops to the wait queue and the drain
+    stops). Unlike the FFD sweep this is exact in parity mode too — the
+    drain body performs no order-sensitive float accumulation (wait
+    recording happens at the wait-head attempt, not here)."""
+    ready = s.ready
+    n_sweep = jnp.where(wait_active, 0,
+                        jnp.minimum(ready.count, QC)).astype(jnp.int32)
+    pos = jnp.arange(QC, dtype=jnp.int32)
+    act0 = pos < n_sweep
+    rows = ready.data[:QC]  # queue order: position == slot
+    jobs = Q.JobRec(vec=rows)
+
+    def cond(carry):
+        free, resolved, node_sel, cnt, run_full, stopped, fail_idx = carry
+        return jnp.logical_and(
+            jnp.logical_not(stopped),
+            jnp.any(jnp.logical_and(act0, jnp.logical_not(resolved))))
+
+    def step(carry):
+        free, resolved, node_sel, cnt, run_full, stopped, fail_idx = carry
+        active = jnp.logical_and(act0, jnp.logical_not(resolved))
+        feas = jax.vmap(lambda c, m, g: P.feasible(
+            free, s.node_active, c, m, g))(jobs.cores, jobs.mem, jobs.gpu)
+        feas = jnp.logical_and(feas, active[:, None])
+        feas_any = jnp.any(feas, axis=-1)
+        tgt = jnp.argmax(feas, axis=-1).astype(jnp.int32)
+        tgt_hot = jnp.logical_and(
+            feas_any[:, None],
+            tgt[:, None] == jnp.arange(feas.shape[1],
+                                       dtype=jnp.int32)[None, :],
+        ).astype(jnp.int32)
+        prior = jnp.cumsum(tgt_hot, axis=0) - tgt_hot
+        conflict = jnp.einsum("kn,kn->k", prior, tgt_hot) > 0
+        infeas = jnp.logical_and(active, jnp.logical_not(feas_any))
+        cand = jnp.logical_and(feas_any, jnp.logical_not(conflict))
+        r = jnp.cumsum(cand.astype(jnp.int32)) - cand.astype(jnp.int32)
+        cap_left = s.run.capacity - n_active - cnt
+        slotviol = jnp.logical_and(cand, r >= cap_left)
+        breaker = jnp.logical_or(conflict, jnp.logical_or(infeas, slotviol))
+        # positions strictly before the first breaker
+        before_break = jnp.cumsum(breaker.astype(jnp.int32)) == 0
+        place = jnp.logical_and(cand, before_break)
+        any_break = jnp.any(breaker)
+        b = jnp.argmax(breaker).astype(jnp.int32)  # first breaker position
+        b_hot = jnp.logical_and(pos == b, any_break)
+        failed = jnp.logical_and(
+            any_break,
+            jnp.logical_or(jnp.any(jnp.logical_and(b_hot, infeas)),
+                           jnp.any(jnp.logical_and(b_hot, slotviol))))
+        run_full = run_full + jnp.any(
+            jnp.logical_and(b_hot, slotviol)).astype(jnp.int32)
+        resolved = jnp.logical_or(resolved,
+                                  jnp.logical_or(place,
+                                                 jnp.logical_and(b_hot, failed)))
+        used = jnp.einsum("kn,kr->nr",
+                          tgt_hot * place[:, None].astype(jnp.int32),
+                          jobs.res[..., : free.shape[-1]])
+        free = free - used
+        node_sel = jnp.where(place, tgt, node_sel)
+        cnt = cnt + place.sum().astype(jnp.int32)
+        stopped = jnp.logical_or(stopped, failed)
+        fail_idx = jnp.where(failed, b, fail_idx)
+        return free, resolved, node_sel, cnt, run_full, stopped, fail_idx
+
+    free, resolved, node_sel, cnt, run_full, stopped, fail_idx = \
+        jax.lax.while_loop(cond, step, (
+            s.node_free, jnp.logical_not(act0), jnp.full((QC,), P.NO_NODE),
+            jnp.int32(0), jnp.int32(0), jnp.zeros((), bool), jnp.int32(-1)))
+
+    placed_pos = node_sel >= jnp.int32(0)
+    n_taken = cnt + stopped.astype(jnp.int32)  # pops include the failure
+    fhot = (pos == fail_idx).astype(jnp.int32)
+    fail_job = Q.JobRec(vec=jnp.einsum("k,kf->f", fhot, rows))
+    all_rows = jax.vmap(lambda v, n: R.row_from_job(Q.JobRec(vec=v), n, t)
+                        )(rows, node_sel)
+    rankp = jnp.cumsum(placed_pos.astype(jnp.int32)) - 1
+    bhot = jnp.logical_and(
+        placed_pos[:, None],
+        rankp[:, None] == jnp.arange(QC, dtype=jnp.int32)[None, :],
+    ).astype(jnp.int32)
+    buf = jnp.einsum("kb,kf->bf", bhot, all_rows)
+    trace = s.trace
+    if cfg.record_trace:
+        trace = _trace_append_many(trace, placed_pos, t, jobs.id, node_sel,
+                                   st.SRC_READY)
+    s = s.replace(node_free=free, trace=trace,
+                  drops=s.drops.replace(run_full=s.drops.run_full + run_full),
+                  placed_total=s.placed_total + cnt)
+    return s, n_taken, fail_job, stopped, buf, cnt
+
+
 def _fifo_local(s: SimState, t, cfg: SimConfig):
     """Fifo() (scheduler.go:216-296) as ordered masked phases; see PARITY.md
     for the derivation of the per-tick semantics from the Go loop's
@@ -594,11 +697,15 @@ def _fifo_local(s: SimState, t, cfg: SimConfig):
         return (s2, i + 1, jnp.logical_or(stopped, fail), n_taken, fail_job,
                 jnp.logical_or(any_fail, fail), buf, cnt)
 
-    init = (s, jnp.int32(0), jnp.zeros((), bool), jnp.int32(0),
-            Q.JobRec.invalid(), jnp.zeros((), bool),
-            jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0))
-    s, _, _, n_taken, fail_job, any_fail, buf, cnt = jax.lax.while_loop(
-        dcond, dstep, init)
+    if cfg.fifo_drain == "wave":
+        s, n_taken, fail_job, any_fail, buf, cnt = _fifo_drain_wave(
+            s, t, cfg, wait_active, n_active, QC)
+    else:
+        init = (s, jnp.int32(0), jnp.zeros((), bool), jnp.int32(0),
+                Q.JobRec.invalid(), jnp.zeros((), bool),
+                jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0))
+        s, _, _, n_taken, fail_job, any_fail, buf, cnt = jax.lax.while_loop(
+            dcond, dstep, init)
     # the drain consumes a strict prefix of the ready queue; its placements
     # flush into the set before the wait-head attempt reads occupancy
     s = s.replace(run=R.start_many(s.run, buf, cnt),

@@ -183,3 +183,56 @@ class TestFFDWaveSweep:
                                    np.asarray(b.wait_total), rtol=1e-6)
         assert total_drops(a) == total_drops(b)
         assert int(np.asarray(a.placed_total).sum()) > 0
+
+
+class TestFifoDrainWave:
+    """engine._fifo_drain_wave == the serial ready drain, end to end —
+    including the drain-stops-at-first-failure pop/wait-push bookkeeping
+    and the run_full-on-slot-exhaustion drop. Runs in parity mode (the
+    wave drain is exact there too and is the default everywhere)."""
+
+    @pytest.mark.parametrize("seed,lam,running",
+                             [(3, 30.0, 64), (11, 60.0, 64),
+                              (17, 60.0, 6), (29, 45.0, 64)])
+    def test_wave_matches_serial(self, seed, lam, running):
+        import dataclasses
+
+        import multi_cluster_simulator_tpu as mcs
+        from multi_cluster_simulator_tpu.config import (
+            PolicyKind, SimConfig, WorkloadConfig,
+        )
+        from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+        from multi_cluster_simulator_tpu.utils.trace import (
+            extract_trace, total_drops,
+        )
+        from multi_cluster_simulator_tpu.workload.generator import (
+            generate_arrivals,
+        )
+
+        base = SimConfig(policy=PolicyKind.FIFO, parity=True,
+                         queue_capacity=256, max_running=running,
+                         max_arrivals=1024, max_nodes=5, n_res=2,
+                         record_trace=True,
+                         workload=WorkloadConfig(poisson_lambda_per_min=lam))
+        C = 4
+        specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+        arr = generate_arrivals(base.workload, C, base.max_arrivals, 250_000,
+                                16, 12_000, seed=seed)
+        outs = {}
+        for mode in ("serial", "wave"):
+            cfg = dataclasses.replace(base, fifo_drain=mode)
+            outs[mode] = mcs.Engine(cfg).run_jit()(
+                mcs.init_state(cfg, specs), arr, 250)
+        a, b = outs["serial"], outs["wave"]
+        assert extract_trace(a) == extract_trace(b)
+        for f in ("node_free", "placed_total", "wait_total"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                err_msg=f)
+        for qn in ("ready", "wait"):
+            np.testing.assert_array_equal(np.asarray(getattr(a, qn).data),
+                                          np.asarray(getattr(b, qn).data))
+            np.testing.assert_array_equal(np.asarray(getattr(a, qn).count),
+                                          np.asarray(getattr(b, qn).count))
+        assert total_drops(a) == total_drops(b)
+        assert int(np.asarray(a.placed_total).sum()) > 0
