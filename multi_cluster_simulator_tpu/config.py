@@ -148,6 +148,10 @@ class SimConfig:
     # keeps the one-job-per-iteration loop. The oracle parity suite and
     # the TPU parity gate run the wave path and must stay bit-exact.
     fifo_drain: str = "wave"
+    # Fast-mode DELAY Level1 sweep form (parity mode always serial: the
+    # remove-then-skip quirk + ordered float wait accumulation are part
+    # of bit-parity). Same wave technique as ffd_sweep.
+    delay_sweep: str = "wave"
 
     # --- instrumentation ---
     record_trace: bool = False  # record per-placement events

@@ -342,8 +342,13 @@ def _delay_local(s: SimState, t, cfg: SimConfig):
     s, _, rec, placed, _, buf, cnt = jax.lax.while_loop(cond, step, init)
     l1 = Q.compact(Q.set_col(s.l1, Q.FREC, rec), jnp.logical_not(placed))
     s = s.replace(l1=l1, run=R.start_many(s.run, buf, cnt))
+    return _delay_l0_head(s, t, cfg)
 
-    # ---- Level0 head ----
+
+def _delay_l0_head(s: SimState, t, cfg: SimConfig):
+    """The Level0-head half of Delay() (scheduler.go:332-366): one
+    placement attempt on the head, else promote to Level1 after
+    MaxWaitTime. Shared by the serial and wave Level1 sweeps."""
     process = s.l0.count > 0
     job = Q.head(s.l0)
     total, new_rec = _record_wait(s.wait_total, job.rec_wait, job.enq_t, t, process)
@@ -363,6 +368,56 @@ def _delay_local(s: SimState, t, cfg: SimConfig):
             queue=s.drops.queue + Q.push_back_dropped(s.l1, promote)),
     )
     return s
+
+
+def _delay_wave_local(s: SimState, t, cfg: SimConfig):
+    """Fast-mode Delay(): the Level1 sweep as speculative waves
+    (``_wave_place``; equivalence argument in ``_ffd_wave_local``) plus
+    the shared Level0-head attempt. Parity mode keeps the serial sweep —
+    its remove-then-skip quirk and ordered float wait accumulation are
+    part of bit-parity (PARITY.md)."""
+    QC = min(cfg.queue_capacity, cfg.max_placements_per_tick)
+    n_sweep = jnp.minimum(s.l1.count, QC)
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
+    act0 = jnp.arange(QC, dtype=jnp.int32) < n_sweep
+    rows = s.l1.data[:QC]  # sweep order == queue order (no sort)
+    jobs = Q.JobRec(vec=rows)
+
+    # wait accounting, vectorized over the processed prefix (fast mode:
+    # no serial-float-order constraint)
+    processed_slot = s.l1.slot_valid() & (
+        jnp.arange(s.l1.capacity, dtype=jnp.int32) < n_sweep)
+    cur = (t - s.l1.data[:, Q.FENQ]).astype(jnp.int32)
+    frec = s.l1.data[:, Q.FREC]
+    delta = jnp.where(processed_slot, (cur - frec).astype(jnp.float32), 0.0)
+    l1 = Q.set_col(s.l1, Q.FREC, jnp.where(processed_slot, cur, frec))
+    s = s.replace(wait_total=s.wait_total + delta.sum(), l1=l1)
+
+    free, node_sel, cnt, run_full = _wave_place(
+        s.node_free, s.node_active, s.run.capacity, n_active, jobs, act0)
+
+    placed_pos = node_sel >= jnp.int32(0)
+    all_rows = jax.vmap(lambda v, n: R.row_from_job(Q.JobRec(vec=v), n, t)
+                        )(rows, node_sel)
+    rankp = jnp.cumsum(placed_pos.astype(jnp.int32)) - 1
+    bhot = jnp.logical_and(
+        placed_pos[:, None],
+        rankp[:, None] == jnp.arange(QC, dtype=jnp.int32)[None, :],
+    ).astype(jnp.int32)
+    buf = jnp.einsum("kb,kf->bf", bhot, all_rows)
+    trace = s.trace
+    if cfg.record_trace:
+        trace = _trace_append_many(trace, placed_pos, t, jobs.id, node_sel,
+                                   st.SRC_L1)
+    placed_slot = jnp.pad(placed_pos, (0, s.l1.capacity - QC))
+    s = s.replace(
+        node_free=free, trace=trace,
+        drops=s.drops.replace(run_full=s.drops.run_full + run_full),
+        placed_total=s.placed_total + cnt,
+        jobs_in_queue=s.jobs_in_queue - cnt,
+        l1=Q.compact(s.l1, jnp.logical_not(placed_slot)),
+        run=R.start_many(s.run, buf, cnt))
+    return _delay_l0_head(s, t, cfg)
 
 
 def _ffd_local(s: SimState, t, cfg: SimConfig):
@@ -431,6 +486,62 @@ def _trace_append_many(tr, take, t, job_ids, nodes, src):
                       n=tr.n + ok.sum().astype(jnp.int32))
 
 
+def _wave_place(free0, node_active, run_cap, n_active, jobs: Q.JobRec, act0):
+    """The wave-placement core shared by the FFD and DELAY fast-mode
+    sweeps: place ``jobs`` (a [QC]-batched JobRec in sweep order, active
+    where ``act0``) by speculative conflict-free-prefix waves. Returns
+    ``(free', node_sel, cnt, run_full)`` with ``node_sel[k]`` the placed
+    node per position (NO_NODE where unplaced). Equivalence argument:
+    ``_ffd_wave_local`` docstring."""
+    QC = act0.shape[0]
+
+    def cond(carry):
+        free, resolved, node_sel, cnt, run_full = carry
+        return jnp.any(jnp.logical_and(act0, jnp.logical_not(resolved)))
+
+    def step(carry):
+        free, resolved, node_sel, cnt, run_full = carry
+        active = jnp.logical_and(act0, jnp.logical_not(resolved))
+        feas = jax.vmap(lambda c, m, g: P.feasible(
+            free, node_active, c, m, g))(jobs.cores, jobs.mem, jobs.gpu)
+        feas = jnp.logical_and(feas, active[:, None])  # [QC, N]
+        feas_any = jnp.any(feas, axis=-1)
+        tgt = jnp.argmax(feas, axis=-1).astype(jnp.int32)  # first-fit node
+        tgt_hot = jnp.logical_and(
+            feas_any[:, None],
+            tgt[:, None] == jnp.arange(feas.shape[1],
+                                       dtype=jnp.int32)[None, :],
+        ).astype(jnp.int32)  # [QC, N], rows zero where infeasible/inactive
+        prior = jnp.cumsum(tgt_hot, axis=0) - tgt_hot
+        conflict = jnp.einsum("kn,kn->k", prior, tgt_hot) > 0
+        blocked = jnp.cumsum(conflict.astype(jnp.int32)) > 0  # self included
+        place_try = jnp.logical_and(feas_any, jnp.logical_not(blocked))
+        rank = jnp.cumsum(place_try.astype(jnp.int32)) - 1
+        has_slot = (n_active + cnt + rank) < run_cap
+        place = jnp.logical_and(place_try, has_slot)
+        slot_full = jnp.logical_and(place_try, jnp.logical_not(has_slot))
+        # infeasible-now is infeasible-forever (free only shrinks): resolve
+        # failed even past the block point; slot-exhausted jobs resolve too
+        # (run_full drop), exactly as the serial sweep counts them
+        resolved = jnp.logical_or(
+            resolved, jnp.logical_or(
+                place, jnp.logical_or(
+                    slot_full,
+                    jnp.logical_and(active, jnp.logical_not(feas_any)))))
+        used = jnp.einsum("kn,kr->nr", tgt_hot * place[:, None].astype(jnp.int32),
+                          jobs.res[..., : free.shape[-1]])
+        free = free - used
+        node_sel = jnp.where(place, tgt, node_sel)
+        cnt = cnt + place.sum().astype(jnp.int32)
+        run_full = run_full + slot_full.sum().astype(jnp.int32)
+        return free, resolved, node_sel, cnt, run_full
+
+    free, _, node_sel, cnt, run_full = jax.lax.while_loop(
+        cond, step, (free0, jnp.logical_not(act0),
+                     jnp.full((QC,), P.NO_NODE), jnp.int32(0), jnp.int32(0)))
+    return free, node_sel, cnt, run_full
+
+
 def _ffd_wave_local(s: SimState, t, cfg: SimConfig):
     """``_ffd_local`` restructured as speculative placement waves — same
     placements, a fraction of the serial steps.
@@ -483,50 +594,8 @@ def _ffd_wave_local(s: SimState, t, cfg: SimConfig):
     l0 = Q.set_col(s.l0, Q.FREC, jnp.where(processed_slot, cur, frec))
     s = s.replace(wait_total=s.wait_total + delta.sum(), l0=l0)
 
-    def cond(carry):
-        free, resolved, node_sel, cnt, run_full = carry
-        return jnp.any(jnp.logical_and(act0, jnp.logical_not(resolved)))
-
-    def step(carry):
-        free, resolved, node_sel, cnt, run_full = carry
-        active = jnp.logical_and(act0, jnp.logical_not(resolved))
-        feas = jax.vmap(lambda c, m, g: P.feasible(
-            free, s.node_active, c, m, g))(jobs.cores, jobs.mem, jobs.gpu)
-        feas = jnp.logical_and(feas, active[:, None])  # [QC, N]
-        feas_any = jnp.any(feas, axis=-1)
-        tgt = jnp.argmax(feas, axis=-1).astype(jnp.int32)  # first-fit node
-        tgt_hot = jnp.logical_and(
-            feas_any[:, None],
-            tgt[:, None] == jnp.arange(feas.shape[1],
-                                       dtype=jnp.int32)[None, :],
-        ).astype(jnp.int32)  # [QC, N], rows zero where infeasible/inactive
-        prior = jnp.cumsum(tgt_hot, axis=0) - tgt_hot
-        conflict = jnp.einsum("kn,kn->k", prior, tgt_hot) > 0
-        blocked = jnp.cumsum(conflict.astype(jnp.int32)) > 0  # self included
-        place_try = jnp.logical_and(feas_any, jnp.logical_not(blocked))
-        rank = jnp.cumsum(place_try.astype(jnp.int32)) - 1
-        has_slot = (n_active + cnt + rank) < s.run.capacity
-        place = jnp.logical_and(place_try, has_slot)
-        slot_full = jnp.logical_and(place_try, jnp.logical_not(has_slot))
-        # infeasible-now is infeasible-forever (free only shrinks): resolve
-        # failed even past the block point; slot-exhausted jobs resolve too
-        # (run_full drop), exactly as the serial sweep counts them
-        resolved = jnp.logical_or(
-            resolved, jnp.logical_or(
-                place, jnp.logical_or(
-                    slot_full,
-                    jnp.logical_and(active, jnp.logical_not(feas_any)))))
-        used = jnp.einsum("kn,kr->nr", tgt_hot * place[:, None].astype(jnp.int32),
-                          jobs.res[..., : free.shape[-1]])
-        free = free - used
-        node_sel = jnp.where(place, tgt, node_sel)
-        cnt = cnt + place.sum().astype(jnp.int32)
-        run_full = run_full + slot_full.sum().astype(jnp.int32)
-        return free, resolved, node_sel, cnt, run_full
-
-    free, _, node_sel, cnt, run_full = jax.lax.while_loop(
-        cond, step, (s.node_free, jnp.logical_not(act0),
-                     jnp.full((QC,), P.NO_NODE), jnp.int32(0), jnp.int32(0)))
+    free, node_sel, cnt, run_full = _wave_place(
+        s.node_free, s.node_active, s.run.capacity, n_active, jobs, act0)
 
     placed_pos = node_sel >= jnp.int32(0)  # [QC], in FFD order
     # runset rows in position order, compacted to the buffer prefix
@@ -894,7 +963,10 @@ class Engine:
         want = jnp.zeros((C,), bool)
         bjob_vec = jnp.zeros((C, Q.NF), jnp.int32)
         if cfg.policy == PolicyKind.DELAY:
-            state = jax.vmap(functools.partial(_delay_local, cfg=cfg),
+            delay = (_delay_wave_local
+                     if not cfg.parity and cfg.delay_sweep == "wave"
+                     else _delay_local)
+            state = jax.vmap(functools.partial(delay, cfg=cfg),
                              in_axes=(_STATE_AXES, None), out_axes=_STATE_AXES)(state, t)
         elif cfg.policy == PolicyKind.FFD:
             ffd = (_ffd_wave_local

@@ -236,3 +236,59 @@ class TestFifoDrainWave:
                                           np.asarray(getattr(b, qn).count))
         assert total_drops(a) == total_drops(b)
         assert int(np.asarray(a.placed_total).sum()) > 0
+
+
+class TestDelayWaveSweep:
+    """engine._delay_wave_local == the serial fast-mode Level1 sweep,
+    end to end, including full trader-market interplay (the sweep's
+    placements feed the market's utilization policy and Level1 sizing)."""
+
+    @pytest.mark.parametrize("seed,trader", [(5, False), (5, True),
+                                             (13, True)])
+    def test_wave_matches_serial(self, seed, trader):
+        import dataclasses
+
+        import multi_cluster_simulator_tpu as mcs
+        from multi_cluster_simulator_tpu.config import (
+            MatchKind, PolicyKind, SimConfig, TraderConfig,
+        )
+        from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+        from multi_cluster_simulator_tpu.utils.trace import (
+            extract_trace, total_drops,
+        )
+        from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+        base = SimConfig(policy=PolicyKind.DELAY, parity=False,
+                         max_placements_per_tick=8, queue_capacity=64,
+                         max_running=48, max_arrivals=160,
+                         max_ingest_per_tick=8, max_nodes=5,
+                         max_virtual_nodes=4 if trader else 0,
+                         record_trace=True,
+                         trader=TraderConfig(enabled=trader,
+                                             matching=MatchKind.SINKHORN,
+                                             carve_mode="sane"))
+        C, jobs_per, horizon = 8, 160, 200_000
+        arr = uniform_stream(C, jobs_per, horizon, max_cores=24,
+                             max_mem=18_000, max_dur_ms=60_000, seed=seed,
+                             max_gpus=2, gpu_frac=0.1)
+        specs = [uniform_cluster(c + 1, 5, gpus=8 if c % 2 == 0 else 0)
+                 for c in range(C)]
+        n_ticks = horizon // 1000 + 60
+        outs = {}
+        for mode in ("serial", "wave"):
+            cfg = dataclasses.replace(base, delay_sweep=mode)
+            outs[mode] = mcs.Engine(cfg).run_jit()(
+                mcs.init_state(cfg, specs), arr, n_ticks)
+        a, b = outs["serial"], outs["wave"]
+        assert extract_trace(a) == extract_trace(b)
+        for f in ("node_free", "placed_total", "jobs_in_queue",
+                  "node_active"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                err_msg=f)
+        np.testing.assert_array_equal(np.asarray(a.l1.data),
+                                      np.asarray(b.l1.data))
+        np.testing.assert_allclose(np.asarray(a.wait_total),
+                                   np.asarray(b.wait_total), rtol=1e-6)
+        assert total_drops(a) == total_drops(b)
+        assert int(np.asarray(a.placed_total).sum()) > 0
