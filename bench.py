@@ -371,6 +371,10 @@ def bench_sinkhorn(quick=False):
                     # 4 slots covers the measured per-cluster win maximum
                     # (the vslot drop counter is the guard)
                     max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=4,
+                    # the 8-wide sweep leaves the wave form nothing to
+                    # parallelize (A/B: serial 6.59s vs wave 6.78s min) —
+                    # the market, not the sweep, dominates this config
+                    delay_sweep="serial",
                     trader=TraderConfig(enabled=True,
                                         matching=MatchKind.SINKHORN,
                                         carve_mode="sane"))
