@@ -486,6 +486,40 @@ def _trace_append_many(tr, take, t, job_ids, nodes, src):
                       n=tr.n + ok.sum().astype(jnp.int32))
 
 
+def _wave_probe(free, node_active, jobs: Q.JobRec, active):
+    """The per-wave feasibility core shared by every speculative sweep
+    (``_wave_place``, ``_fifo_drain_wave``): first-fit target selection and
+    same-target conflict detection for the active rows under the current
+    ``free``. This is the equivalence-critical logic — any edit here changes
+    all wave forms together (tests/test_kernel_equiv.py pins wave==serial).
+
+    Returns ``(feas_any, tgt, tgt_hot, conflict)``: per-row feasibility,
+    first-fit node index, its one-hot [QC, N] form (zero rows where
+    infeasible/inactive), and whether an earlier active row targets the
+    same node this wave."""
+    feas = jax.vmap(lambda c, m, g: P.feasible(
+        free, node_active, c, m, g))(jobs.cores, jobs.mem, jobs.gpu)
+    feas = jnp.logical_and(feas, active[:, None])  # [QC, N]
+    feas_any = jnp.any(feas, axis=-1)
+    tgt = jnp.argmax(feas, axis=-1).astype(jnp.int32)  # first-fit node
+    tgt_hot = jnp.logical_and(
+        feas_any[:, None],
+        tgt[:, None] == jnp.arange(feas.shape[1],
+                                   dtype=jnp.int32)[None, :],
+    ).astype(jnp.int32)
+    prior = jnp.cumsum(tgt_hot, axis=0) - tgt_hot
+    conflict = jnp.einsum("kn,kn->k", prior, tgt_hot) > 0
+    return feas_any, tgt, tgt_hot, conflict
+
+
+def _wave_occupy(free, tgt_hot, place, jobs: Q.JobRec):
+    """Subtract the accepted rows' resources from ``free``: one [QC, N] x
+    [QC, R] contraction instead of per-row scatter-subtracts."""
+    used = jnp.einsum("kn,kr->nr", tgt_hot * place[:, None].astype(jnp.int32),
+                      jobs.res[..., : free.shape[-1]])
+    return free - used
+
+
 def _wave_place(free0, node_active, run_cap, n_active, jobs: Q.JobRec, act0):
     """The wave-placement core shared by the FFD and DELAY fast-mode
     sweeps: place ``jobs`` (a [QC]-batched JobRec in sweep order, active
@@ -502,18 +536,8 @@ def _wave_place(free0, node_active, run_cap, n_active, jobs: Q.JobRec, act0):
     def step(carry):
         free, resolved, node_sel, cnt, run_full = carry
         active = jnp.logical_and(act0, jnp.logical_not(resolved))
-        feas = jax.vmap(lambda c, m, g: P.feasible(
-            free, node_active, c, m, g))(jobs.cores, jobs.mem, jobs.gpu)
-        feas = jnp.logical_and(feas, active[:, None])  # [QC, N]
-        feas_any = jnp.any(feas, axis=-1)
-        tgt = jnp.argmax(feas, axis=-1).astype(jnp.int32)  # first-fit node
-        tgt_hot = jnp.logical_and(
-            feas_any[:, None],
-            tgt[:, None] == jnp.arange(feas.shape[1],
-                                       dtype=jnp.int32)[None, :],
-        ).astype(jnp.int32)  # [QC, N], rows zero where infeasible/inactive
-        prior = jnp.cumsum(tgt_hot, axis=0) - tgt_hot
-        conflict = jnp.einsum("kn,kn->k", prior, tgt_hot) > 0
+        feas_any, tgt, tgt_hot, conflict = _wave_probe(free, node_active,
+                                                       jobs, active)
         blocked = jnp.cumsum(conflict.astype(jnp.int32)) > 0  # self included
         place_try = jnp.logical_and(feas_any, jnp.logical_not(blocked))
         rank = jnp.cumsum(place_try.astype(jnp.int32)) - 1
@@ -528,9 +552,7 @@ def _wave_place(free0, node_active, run_cap, n_active, jobs: Q.JobRec, act0):
                 place, jnp.logical_or(
                     slot_full,
                     jnp.logical_and(active, jnp.logical_not(feas_any)))))
-        used = jnp.einsum("kn,kr->nr", tgt_hot * place[:, None].astype(jnp.int32),
-                          jobs.res[..., : free.shape[-1]])
-        free = free - used
+        free = _wave_occupy(free, tgt_hot, place, jobs)
         node_sel = jnp.where(place, tgt, node_sel)
         cnt = cnt + place.sum().astype(jnp.int32)
         run_full = run_full + slot_full.sum().astype(jnp.int32)
@@ -654,18 +676,8 @@ def _fifo_drain_wave(s: SimState, t, cfg: SimConfig, wait_active, n_active,
     def step(carry):
         free, resolved, node_sel, cnt, run_full, stopped, fail_idx = carry
         active = jnp.logical_and(act0, jnp.logical_not(resolved))
-        feas = jax.vmap(lambda c, m, g: P.feasible(
-            free, s.node_active, c, m, g))(jobs.cores, jobs.mem, jobs.gpu)
-        feas = jnp.logical_and(feas, active[:, None])
-        feas_any = jnp.any(feas, axis=-1)
-        tgt = jnp.argmax(feas, axis=-1).astype(jnp.int32)
-        tgt_hot = jnp.logical_and(
-            feas_any[:, None],
-            tgt[:, None] == jnp.arange(feas.shape[1],
-                                       dtype=jnp.int32)[None, :],
-        ).astype(jnp.int32)
-        prior = jnp.cumsum(tgt_hot, axis=0) - tgt_hot
-        conflict = jnp.einsum("kn,kn->k", prior, tgt_hot) > 0
+        feas_any, tgt, tgt_hot, conflict = _wave_probe(free, s.node_active,
+                                                       jobs, active)
         infeas = jnp.logical_and(active, jnp.logical_not(feas_any))
         cand = jnp.logical_and(feas_any, jnp.logical_not(conflict))
         r = jnp.cumsum(cand.astype(jnp.int32)) - cand.astype(jnp.int32)
@@ -687,10 +699,7 @@ def _fifo_drain_wave(s: SimState, t, cfg: SimConfig, wait_active, n_active,
         resolved = jnp.logical_or(resolved,
                                   jnp.logical_or(place,
                                                  jnp.logical_and(b_hot, failed)))
-        used = jnp.einsum("kn,kr->nr",
-                          tgt_hot * place[:, None].astype(jnp.int32),
-                          jobs.res[..., : free.shape[-1]])
-        free = free - used
+        free = _wave_occupy(free, tgt_hot, place, jobs)
         node_sel = jnp.where(place, tgt, node_sel)
         cnt = cnt + place.sum().astype(jnp.int32)
         stopped = jnp.logical_or(stopped, failed)
@@ -899,6 +908,11 @@ class Engine:
         self.ex = ex if ex is not None else LocalExchange()
         if cfg.n_res not in (2, 3):
             raise ValueError(f"n_res must be 2 or 3, got {cfg.n_res}")
+        for field in ("ffd_sweep", "fifo_drain", "delay_sweep"):
+            v = getattr(cfg, field)
+            if v not in ("wave", "serial"):
+                raise ValueError(
+                    f"{field} must be 'wave' or 'serial', got {v!r}")
         if cfg.trader.enabled and cfg.n_res != 3:
             raise ValueError("the trader market carves 3-dim resources; "
                              "set n_res=3 when trader.enabled")

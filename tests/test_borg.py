@@ -142,14 +142,18 @@ class TestToArrivals:
         assert int(np.asarray(out.placed_total).sum()) == 24
 
 
-def test_vendored_sample_parses():
-    """The checked-in sample slice round-trips through the full path."""
+def test_generated_sample_parses():
+    """The deterministic sample slice (generated on first use, not
+    committed — tools/make_borg_sample.py) round-trips the full path."""
     import os
+    import sys
 
-    path = os.path.join(os.path.dirname(__file__), "..", "assets",
-                        "borg2019_sample.jsonl.gz")
-    j = load_borg(path)
-    assert len(j) > 30_000
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.make_borg_sample import ensure
+    j = load_borg(ensure())
+    assert len(j) > 1_000_000
     arr, meta = to_arrivals(j, 8, 64, 32, 24_000, time_scale=1000.0)
     assert meta["rows_used"] == 512
     assert (np.asarray(arr.n) == 64).all()

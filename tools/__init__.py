@@ -1,0 +1,3 @@
+"""Offline developer tools (sample generation, cost probes). A package so
+bench.py and the tests can import the deterministic Borg-sample generator
+without duplicating file-path module loading."""
