@@ -630,12 +630,6 @@ def _borg_sample_path():
     """The deterministic schema-faithful sample, generated on first use
     (tools/make_borg_sample.py — a ~35 MB artifact is built from a fixed
     seed rather than committed; round-4 advisor finding)."""
-    import os
-    import sys
-
-    root = os.path.dirname(os.path.abspath(__file__))
-    if root not in sys.path:
-        sys.path.insert(0, root)
     from tools.make_borg_sample import ensure
     return ensure()
 

@@ -145,13 +145,8 @@ class TestToArrivals:
 def test_generated_sample_parses():
     """The deterministic sample slice (generated on first use, not
     committed — tools/make_borg_sample.py) round-trips the full path."""
-    import os
-    import sys
-
-    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
-    if root not in sys.path:
-        sys.path.insert(0, root)
     from tools.make_borg_sample import ensure
+
     j = load_borg(ensure())
     assert len(j) > 1_000_000
     arr, meta = to_arrivals(j, 8, 64, 32, 24_000, time_scale=1000.0)

@@ -92,7 +92,10 @@ def generate(out: str = OUT) -> str:
     # existence), and concurrent first runs must not interleave writes
     tmp = f"{out}.tmp.{os.getpid()}"
     with open(tmp, "wb") as raw:
-        with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0) as gz:
+        # filename="" keeps the gzip FNAME header empty — writing through a
+        # PID-suffixed tmp path must not leak into the bytes (the sample is
+        # byte-deterministic everywhere)
+        with gzip.GzipFile(filename="", fileobj=raw, mode="wb", mtime=0) as gz:
             buf = []
             for t, k, r in zip(times[order], kinds[order], rows[order]):
                 r = int(r)
@@ -118,12 +121,34 @@ def generate(out: str = OUT) -> str:
             if buf:
                 gz.write(("\n".join(buf) + "\n").encode())
     os.replace(tmp, out)
+    with open(_meta_path(out), "w") as f:
+        json.dump(_params(), f)
     return out
 
 
+def _params() -> dict:
+    """Generator fingerprint: a stale on-disk sample (the file is
+    gitignored and survives generator re-parameterizations — this build
+    itself grew it 36k->150k collections) must be regenerated, not reused."""
+    return {"n_collections": N_COLLECTIONS, "mean_instances": MEAN_INSTANCES,
+            "span_us": SPAN_US, "seed": 2019, "format": 2}
+
+
+def _meta_path(out: str) -> str:
+    return out + ".meta.json"
+
+
 def ensure(out: str = OUT) -> str:
-    """Generate the sample only if absent — the bench/test entry point."""
-    if not os.path.exists(out):
+    """Generate the sample only if absent or generated with different
+    parameters — the bench/test entry point."""
+    fresh = False
+    if os.path.exists(out):
+        try:
+            with open(_meta_path(out)) as f:
+                fresh = json.load(f) == _params()
+        except (OSError, ValueError):
+            fresh = False
+    if not fresh:
         import sys
         print(f"# generating {out} (~3M events, one-time, <1 min)...",
               file=sys.stderr, flush=True)
