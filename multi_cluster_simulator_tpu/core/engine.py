@@ -43,6 +43,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
@@ -242,6 +243,69 @@ def pack_arrivals(arr: Arrivals) -> tuple[jax.Array, jax.Array]:
     rows = jnp.stack([arr.id, arr.cores, arr.mem, arr.gpu, arr.dur, arr.t,
                       own, zero], axis=-1).astype(jnp.int32)
     return rows, arr.n
+
+
+def pack_arrivals_by_tick(arr: Arrivals, n_ticks: int,
+                          tick_ms: int) -> st.TickArrivals:
+    """Bucket the stream by destination tick (host-side numpy, once per
+    run): a job arriving at ``ta`` is ingested at the first tick whose
+    clock ``t = k * tick_ms`` satisfies ``ta <= t`` — exactly the engine's
+    ``due`` rule and Go's per-tick drain of everything already posted
+    (server.go:53-78 + the 1 s loop). Arrivals beyond the horizon are
+    dropped here exactly as the windowed path never reaches them."""
+    t = np.asarray(arr.t)
+    C, A = t.shape
+    n = np.asarray(arr.n)
+    valid = np.arange(A)[None, :] < n[:, None]
+    # the rank-in-group computation below requires time-sorted rows; an
+    # unsorted stream would produce negative ranks that wrap into wrong
+    # slots (silently corrupt buckets) — fail fast instead
+    if A > 1 and not np.all(np.diff(t, axis=1)[valid[:, 1:]] >= 0):
+        raise ValueError("pack_arrivals_by_tick requires per-cluster "
+                         "time-sorted arrivals")
+    # destination tick index (0-based scan step); tick k has clock (k+1)*tick_ms
+    dest = np.maximum((t + tick_ms - 1) // tick_ms, 1) - 1
+    ok = valid & (dest < n_ticks)
+    dest = np.where(ok, dest, n_ticks)  # parked on a virtual overflow tick
+    # per-cluster arrivals are time-sorted, so same-dest rows are contiguous
+    # and rank-in-group = global position - group start
+    counts2d = np.zeros((C, n_ticks + 1), np.int32)
+    np.add.at(counts2d, (np.arange(C)[:, None], dest), 1)
+    firsts = np.zeros((C, n_ticks + 1), np.int64)
+    firsts[:, 1:] = np.cumsum(counts2d, axis=1)[:, :-1]
+    rank = np.arange(A)[None, :] - firsts[np.arange(C)[:, None], dest]
+    K = max(int(counts2d[:, :n_ticks].max(initial=1)), 1)
+    rows = np.broadcast_to(np.asarray(Q._INVALID_ROW),
+                           (n_ticks, C, K, Q.NF)).copy()
+    fields = np.stack([np.asarray(arr.id), np.asarray(arr.cores),
+                       np.asarray(arr.mem), np.asarray(arr.gpu),
+                       np.asarray(arr.dur), t,
+                       np.full_like(t, int(Q.OWN)),
+                       np.zeros_like(t)], axis=-1)  # [C, A, NF]
+    cc, aa = np.nonzero(ok)
+    rows[dest[cc, aa], cc, rank[cc, aa]] = fields[cc, aa]
+    return st.TickArrivals(rows=jnp.asarray(rows),
+                           counts=jnp.asarray(counts2d.T[:n_ticks].copy()))
+
+
+def _ingest_packed_local(s: SimState, rows: jax.Array, cnt: jax.Array, t,
+                         cfg: SimConfig, to_delay: bool):
+    """``_ingest_local`` for pre-bucketed TickArrivals: the tick's rows
+    arrive as a scan input, so there is no due/window scan and no ingest
+    deferral (K covers the data's maximum by construction)."""
+    K = rows.shape[0]
+    valid = jnp.arange(K, dtype=jnp.int32) < cnt
+    batch = Q.JobQueue(data=rows, count=cnt)
+    tgt = s.l0 if to_delay else s.ready
+    dropped = Q.push_many_dropped(tgt, valid)
+    s = s.replace(drops=s.drops.replace(queue=s.drops.queue + dropped))
+    if to_delay:
+        s = s.replace(l0=Q.push_many(s.l0, batch, valid, prefix=True),
+                      wait_jobs=s.wait_jobs + cnt,
+                      jobs_in_queue=s.jobs_in_queue + cnt)
+    else:
+        s = s.replace(ready=Q.push_many(s.ready, batch, valid, prefix=True))
+    return s.replace(arr_ptr=s.arr_ptr + cnt)
 
 
 def _ingest_local(s: SimState, arr_rows: jax.Array, arr_n: jax.Array, t,
@@ -489,14 +553,30 @@ def _trace_append_many(tr, take, t, job_ids, nodes, src):
 def _wave_probe(free, node_active, jobs: Q.JobRec, active):
     """The per-wave feasibility core shared by every speculative sweep
     (``_wave_place``, ``_fifo_drain_wave``): first-fit target selection and
-    same-target conflict detection for the active rows under the current
+    cumulative-overflow detection for the active rows under the current
     ``free``. This is the equivalence-critical logic — any edit here changes
     all wave forms together (tests/test_kernel_equiv.py pins wave==serial).
 
-    Returns ``(feas_any, tgt, tgt_hot, conflict)``: per-row feasibility,
+    A wave accepts *whole same-target groups*, not just distinct targets:
+    for jobs targeting the same node, the running group total (job k's own
+    demand plus all earlier same-target rows) is compared against the
+    node's free vector, and only the row that overflows it (and everything
+    after, via the callers' prefix rules) defers to the next wave. This is
+    exact by the same monotonicity argument as the original
+    distinct-target rule (``_ffd_wave_local`` docstring), extended one
+    step: for an accepted job k targeting node n, earlier accepted jobs on
+    other nodes leave n untouched, earlier accepted jobs ON n are exactly
+    k's group predecessors — whose total including k fits — so when the
+    serial sweep reaches k, nodes before n are still infeasible (free only
+    shrinks) and n is still feasible: the serial sweep picks n too. Without
+    the group rule, homogeneous clusters degrade to one placement per wave
+    (every queued job first-fits the same node), which left the FIFO
+    headline latency-bound at ~backlog iterations per tick.
+
+    Returns ``(feas_any, tgt, tgt_hot, overflow)``: per-row feasibility,
     first-fit node index, its one-hot [QC, N] form (zero rows where
-    infeasible/inactive), and whether an earlier active row targets the
-    same node this wave."""
+    infeasible/inactive), and whether the row's cumulative group demand
+    overflows its target's free capacity this wave."""
     feas = jax.vmap(lambda c, m, g: P.feasible(
         free, node_active, c, m, g))(jobs.cores, jobs.mem, jobs.gpu)
     feas = jnp.logical_and(feas, active[:, None])  # [QC, N]
@@ -507,9 +587,13 @@ def _wave_probe(free, node_active, jobs: Q.JobRec, active):
         tgt[:, None] == jnp.arange(feas.shape[1],
                                    dtype=jnp.int32)[None, :],
     ).astype(jnp.int32)
-    prior = jnp.cumsum(tgt_hot, axis=0) - tgt_hot
-    conflict = jnp.einsum("kn,kn->k", prior, tgt_hot) > 0
-    return feas_any, tgt, tgt_hot, conflict
+    res = jobs.res[..., : free.shape[-1]]  # [QC, R]
+    cum = jnp.cumsum(tgt_hot[:, :, None] * res[:, None, :], axis=0)  # [QC, N, R]
+    group_dem = jnp.einsum("kn,knr->kr", tgt_hot, cum)  # incl. the row itself
+    tgt_free = jnp.einsum("kn,nr->kr", tgt_hot, free)
+    overflow = jnp.logical_and(feas_any,
+                               jnp.any(group_dem > tgt_free, axis=-1))
+    return feas_any, tgt, tgt_hot, overflow
 
 
 def _wave_occupy(free, tgt_hot, place, jobs: Q.JobRec):
@@ -536,9 +620,9 @@ def _wave_place(free0, node_active, run_cap, n_active, jobs: Q.JobRec, act0):
     def step(carry):
         free, resolved, node_sel, cnt, run_full = carry
         active = jnp.logical_and(act0, jnp.logical_not(resolved))
-        feas_any, tgt, tgt_hot, conflict = _wave_probe(free, node_active,
+        feas_any, tgt, tgt_hot, overflow = _wave_probe(free, node_active,
                                                        jobs, active)
-        blocked = jnp.cumsum(conflict.astype(jnp.int32)) > 0  # self included
+        blocked = jnp.cumsum(overflow.astype(jnp.int32)) > 0  # self included
         place_try = jnp.logical_and(feas_any, jnp.logical_not(blocked))
         rank = jnp.cumsum(place_try.astype(jnp.int32)) - 1
         has_slot = (n_active + cnt + rank) < run_cap
@@ -577,16 +661,19 @@ def _ffd_wave_local(s: SimState, t, cfg: SimConfig):
 
     each wave, every unresolved job computes its first-fit target under
     the current ``free``; the accepted set is the longest prefix (in FFD
-    order) whose targets are pairwise distinct. For an accepted job,
-    earlier accepted jobs all placed on *other* nodes, and ``free`` only
+    order) in which every job's cumulative same-target group demand fits
+    its target node (``_wave_probe`` — whole groups land in one wave).
+    For an accepted job, earlier accepted jobs on other nodes leave its
+    target untouched, earlier accepted jobs on the SAME node are its
+    group predecessors whose total including it fits, and ``free`` only
     ever shrinks — so nodes before its target stay infeasible and its
-    target is untouched: exactly the node the serial sweep would pick.
+    target stays feasible: exactly the node the serial sweep would pick.
     A job infeasible under the current ``free`` is infeasible forever
     (monotonicity) and resolves as failed immediately; the first
-    same-node conflict defers itself and everything after it to the next
-    wave. The earliest unresolved job can never conflict, so every wave
-    makes progress and the loop runs at most ceil(backlog / distinct
-    targets) iterations instead of backlog.
+    group-capacity overflow defers itself and everything after it to the
+    next wave. The earliest unresolved job can never overflow (it is
+    feasible and heads its group), so every wave makes progress and the
+    loop runs one iteration per capacity epoch instead of one per job.
 
     Used in fast mode (``parity=False`` — the Go reference has no FFD, so
     there is no Go-semantics constraint either way; ``ffd_sweep="serial"``
@@ -650,15 +737,16 @@ def _fifo_drain_wave(s: SimState, t, cfg: SimConfig, wait_active, n_active,
     ``_fifo_local``, a fraction of the while_loop iterations.
 
     The equivalence argument mirrors ``_ffd_wave_local`` (prefix-restricted
-    acceptance; free only shrinks, so accepted first-fit targets and
-    observed infeasibilities are both stable), with one extra rule for the
-    drain-stops-at-first-failure semantics: each wave accepts candidates
-    only up to the first *breaker* — a conflict (defer to the next wave),
-    an infeasible job, or a run-slot-exhausted job (both of the latter ARE
-    the drain's failing job: it pops to the wait queue and the drain
-    stops). Unlike the FFD sweep this is exact in parity mode too — the
-    drain body performs no order-sensitive float accumulation (wait
-    recording happens at the wait-head attempt, not here)."""
+    group acceptance via ``_wave_probe``; free only shrinks, so accepted
+    first-fit targets and observed infeasibilities are both stable), with
+    one extra rule for the drain-stops-at-first-failure semantics: each
+    wave accepts candidates only up to the first *breaker* — a group
+    capacity overflow (defer to the next wave), an infeasible job, or a
+    run-slot-exhausted job (both of the latter ARE the drain's failing
+    job: it pops to the wait queue and the drain stops). Unlike the FFD
+    sweep this is exact in parity mode too — the drain body performs no
+    order-sensitive float accumulation (wait recording happens at the
+    wait-head attempt, not here)."""
     ready = s.ready
     n_sweep = jnp.where(wait_active, 0,
                         jnp.minimum(ready.count, QC)).astype(jnp.int32)
@@ -676,14 +764,14 @@ def _fifo_drain_wave(s: SimState, t, cfg: SimConfig, wait_active, n_active,
     def step(carry):
         free, resolved, node_sel, cnt, run_full, stopped, fail_idx = carry
         active = jnp.logical_and(act0, jnp.logical_not(resolved))
-        feas_any, tgt, tgt_hot, conflict = _wave_probe(free, s.node_active,
+        feas_any, tgt, tgt_hot, overflow = _wave_probe(free, s.node_active,
                                                        jobs, active)
         infeas = jnp.logical_and(active, jnp.logical_not(feas_any))
-        cand = jnp.logical_and(feas_any, jnp.logical_not(conflict))
+        cand = jnp.logical_and(feas_any, jnp.logical_not(overflow))
         r = jnp.cumsum(cand.astype(jnp.int32)) - cand.astype(jnp.int32)
         cap_left = s.run.capacity - n_active - cnt
         slotviol = jnp.logical_and(cand, r >= cap_left)
-        breaker = jnp.logical_or(conflict, jnp.logical_or(infeas, slotviol))
+        breaker = jnp.logical_or(overflow, jnp.logical_or(infeas, slotviol))
         # positions strictly before the first breaker
         before_break = jnp.cumsum(breaker.astype(jnp.int32)) == 0
         place = jnp.logical_and(cand, before_break)
@@ -936,10 +1024,14 @@ class Engine:
         """One tick, also returning the host-visible TickIO events."""
         return self._tick(state, pack_arrivals(arrivals), emit_io=True)
 
-    def _tick(self, state: SimState, packed_arrivals, emit_io: bool):
+    def _tick(self, state: SimState, packed_arrivals, emit_io: bool,
+              tick_indexed: bool = False):
         """The tick body. ``emit_io=False`` (the batch/scan path) skips the
         TickIO packing work when borrowing doesn't need it — the return-slot
-        argsort is per-tick cost the headline config shouldn't pay."""
+        argsort is per-tick cost the headline config shouldn't pay.
+        ``tick_indexed``: ``packed_arrivals`` is this tick's
+        (rows [C, K, NF], counts [C]) TickArrivals slice instead of the
+        whole stream."""
         cfg = self.cfg
         t = state.t + cfg.tick_ms
 
@@ -968,7 +1060,8 @@ class Engine:
         # 3. arrivals
         arr_rows, arr_n = packed_arrivals
         to_delay = cfg.policy in (PolicyKind.DELAY, PolicyKind.FFD)
-        state = jax.vmap(functools.partial(_ingest_local, cfg=cfg, to_delay=to_delay),
+        ingest = _ingest_packed_local if tick_indexed else _ingest_local
+        state = jax.vmap(functools.partial(ingest, cfg=cfg, to_delay=to_delay),
                          in_axes=(_STATE_AXES, 0, 0, None),
                          out_axes=_STATE_AXES)(state, arr_rows, arr_n, t)
 
@@ -1018,9 +1111,28 @@ class Engine:
         [T, C] stacked per-tick series (the batch-engine form of RunMetrics'
         recorder goroutine, pkg/scheduler/metrics.go:11-31; decimate to the
         reference's 5 s marks host-side, e.g.
-        ``jax.tree.map(lambda a: a[4::5], series)`` — sample 0 is t=1 s)."""
-        packed = pack_arrivals(arrivals)  # once, outside the tick scan
+        ``jax.tree.map(lambda a: a[4::5], series)`` — sample 0 is t=1 s).
+
+        ``arrivals`` may be an ``Arrivals`` stream or a pre-bucketed
+        ``TickArrivals`` (pack_arrivals_by_tick) — the latter feeds each
+        tick its slice as a scan input, skipping the per-tick due-window
+        scan over the whole stream."""
         record = self.cfg.record_metrics
+        if isinstance(arrivals, st.TickArrivals):
+            if arrivals.rows.shape[0] < n_ticks:
+                raise ValueError(
+                    f"TickArrivals covers {arrivals.rows.shape[0]} ticks, "
+                    f"run asked for {n_ticks}")
+
+            def body_ta(s, x):
+                s2 = self._tick(s, x, emit_io=False, tick_indexed=True)[0]
+                return s2, (st.metric_sample(s2) if record else None)
+
+            xs = (arrivals.rows[:n_ticks], arrivals.counts[:n_ticks])
+            state, series = jax.lax.scan(body_ta, state, xs, length=n_ticks)
+            return (state, series) if record else state
+
+        packed = pack_arrivals(arrivals)  # once, outside the tick scan
 
         def body(s, _):
             s2 = self._tick(s, packed, emit_io=False)[0]

@@ -45,6 +45,21 @@ class Arrivals:
 
 
 @struct.dataclass
+class TickArrivals:
+    """The same arrival stream pre-bucketed by destination tick, so the tick
+    scan consumes its slice as a scan input instead of re-scanning the whole
+    [C, A] stream for the due window every tick (engine.pack_arrivals_by_tick
+    builds it host-side; the window scan was a measured ~10% of the headline
+    tick at 4k clusters). K is the maximum arrivals any (tick, cluster) pair
+    receives, computed from the data — ingest can never defer, making the
+    bucketed run observably identical to Go's unbounded ingest by
+    construction."""
+
+    rows: jax.Array  # [T, C, K, Q.NF] pre-packed queue rows per tick
+    counts: jax.Array  # [T, C] int32 arrivals per (tick, cluster)
+
+
+@struct.dataclass
 class TraderState:
     """Per-cluster trader agent state (pkg/trader/trader.go:24-39,71-108).
 

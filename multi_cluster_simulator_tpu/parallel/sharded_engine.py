@@ -18,7 +18,9 @@ from jax.sharding import PartitionSpec as P
 
 from multi_cluster_simulator_tpu.config import SimConfig
 from multi_cluster_simulator_tpu.core.engine import Engine
-from multi_cluster_simulator_tpu.core.state import Arrivals, SimState
+from multi_cluster_simulator_tpu.core.state import (
+    Arrivals, SimState, TickArrivals,
+)
 from multi_cluster_simulator_tpu.parallel.exchange import MeshExchange
 
 
@@ -38,6 +40,11 @@ def _arr_specs(axis: str):
     shard = P(axis)
     return Arrivals(t=shard, id=shard, cores=shard, mem=shard, gpu=shard,
                     dur=shard, n=shard)
+
+
+def _tick_arr_specs(axis: str):
+    """TickArrivals shard on the cluster axis (axis 1; axis 0 is ticks)."""
+    return TickArrivals(rows=P(None, axis), counts=P(None, axis))
 
 
 class ShardedEngine:
@@ -64,16 +71,25 @@ class ShardedEngine:
         C = state.arr_ptr.shape[0]
         if C % n != 0:
             raise ValueError(f"clusters ({C}) must divide by mesh size ({n})")
-        state = _device_put_tree(state, _state_specs(self.axis), self.mesh,
-                                 place)
-        arrivals = _device_put_tree(arrivals, _arr_specs(self.axis),
-                                    self.mesh, place)
-        return state, arrivals
+        return (self.shard_state(state, place),
+                self.shard_arrivals(arrivals, place))
 
-    def run_fn(self, n_ticks: int):
+    def shard_state(self, state: SimState, place=None):
+        return _device_put_tree(state, _state_specs(self.axis), self.mesh,
+                                place)
+
+    def shard_arrivals(self, arrivals, place=None):
+        """Place an Arrivals stream or TickArrivals bucket onto the mesh."""
+        specs = (_tick_arr_specs(self.axis)
+                 if isinstance(arrivals, TickArrivals)
+                 else _arr_specs(self.axis))
+        return _device_put_tree(arrivals, specs, self.mesh, place)
+
+    def run_fn(self, n_ticks: int, tick_indexed: bool = False):
         """A jitted (state, arrivals) -> state advancing n_ticks under
         shard_map (``(state, MetricSample)`` when cfg.record_metrics: the
-        [T, C] series stays cluster-sharded on its second axis)."""
+        [T, C] series stays cluster-sharded on its second axis).
+        ``tick_indexed=True`` takes TickArrivals instead of a stream."""
         eng = self.engine
 
         def body(state, arrivals):
@@ -85,9 +101,11 @@ class ShardedEngine:
             out_specs = (out_specs, MetricSample(
                 t=P(), jobs_in_queue=P(None, self.axis),
                 avg_wait_ms=P(None, self.axis)))
+        arr_specs = (_tick_arr_specs(self.axis) if tick_indexed
+                     else _arr_specs(self.axis))
         mapped = jax.shard_map(
             body, mesh=self.mesh,
-            in_specs=(_state_specs(self.axis), _arr_specs(self.axis)),
+            in_specs=(_state_specs(self.axis), arr_specs),
             out_specs=out_specs,
             check_vma=False)
         return jax.jit(mapped)

@@ -292,3 +292,68 @@ class TestDelayWaveSweep:
                                    np.asarray(b.wait_total), rtol=1e-6)
         assert total_drops(a) == total_drops(b)
         assert int(np.asarray(a.placed_total).sum()) > 0
+
+
+class TestTickIndexedArrivals:
+    """engine.pack_arrivals_by_tick + the TickArrivals scan path must be
+    bit-identical to the windowed Arrivals-stream path on every policy
+    (the bucketing rule IS the engine's due rule: a job arriving at ta
+    ingests at the first tick clock >= ta), including the sharded engine."""
+
+    @pytest.mark.parametrize("policy,parity",
+                             [("FIFO", True), ("DELAY", True),
+                              ("FFD", False)])
+    def test_matches_stream_path(self, policy, parity):
+        import jax
+
+        from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+        from multi_cluster_simulator_tpu.core.engine import (
+            Engine, pack_arrivals_by_tick,
+        )
+        from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+        from multi_cluster_simulator_tpu.core.state import init_state
+        from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+        cfg = SimConfig(policy=PolicyKind[policy], queue_capacity=64,
+                        max_running=64, max_arrivals=256,
+                        max_ingest_per_tick=64, parity=parity, n_res=2,
+                        max_nodes=5, max_virtual_nodes=0, record_trace=True)
+        C, n_ticks = 8, 300
+        arr = uniform_stream(C, 100, 250_000, max_cores=8, max_mem=6_000,
+                             max_dur_ms=30_000, seed=5)
+        eng = Engine(cfg)
+        s0 = init_state(cfg, [uniform_cluster(c + 1, 5) for c in range(C)])
+        a = eng.run_jit()(s0, arr, n_ticks)
+        ta = pack_arrivals_by_tick(arr, n_ticks, cfg.tick_ms)
+        b = eng.run_jit()(s0, ta, n_ticks)
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        assert int(np.asarray(a.placed_total).sum()) == C * 100
+
+    def test_matches_under_mesh(self):
+        import jax
+
+        from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+        from multi_cluster_simulator_tpu.core.engine import (
+            Engine, pack_arrivals_by_tick,
+        )
+        from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+        from multi_cluster_simulator_tpu.core.state import init_state
+        from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
+        from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+        cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=64,
+                        max_running=64, max_arrivals=256,
+                        max_ingest_per_tick=64, parity=True, n_res=2,
+                        max_nodes=5, max_virtual_nodes=0)
+        C, n_ticks = 8, 200
+        arr = uniform_stream(C, 100, 150_000, max_cores=8, max_mem=6_000,
+                             max_dur_ms=30_000, seed=5)
+        s0 = init_state(cfg, [uniform_cluster(c + 1, 5) for c in range(C)])
+        a = Engine(cfg).run_jit()(s0, arr, n_ticks)
+        sh = ShardedEngine(cfg, make_mesh(8))
+        ta = pack_arrivals_by_tick(arr, n_ticks, cfg.tick_ms)
+        s_sh, ta_sh = sh.shard_inputs(s0, ta)
+        b = sh.run_fn(n_ticks, tick_indexed=True)(s_sh, ta_sh)
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Ablation timing of the headline tick's phases on the real TPU: time a
+scan of (subsets of) the tick body over the headline shape to see where
+the milliseconds go. Ephemeral diagnostic — results feed bench tuning."""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    from multi_cluster_simulator_tpu.core import engine as E
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+    C, jobs_per, horizon_ms = 4096, 250, 1_500_000
+    cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=24, max_running=32,
+                    max_arrivals=jobs_per, max_ingest_per_tick=8,
+                    parity=True, n_res=2, max_nodes=5, max_virtual_nodes=0)
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    arrivals = uniform_stream(C, jobs_per, horizon_ms, max_cores=8,
+                              max_mem=6_000, max_dur_ms=60_000, seed=9)
+    state0 = init_state(cfg, specs)
+    packed = E.pack_arrivals(arrivals)
+    N = 400
+
+    def phase_release(s, t):
+        s, _ = jax.vmap(E._release_local, in_axes=(E._STATE_AXES, None),
+                        out_axes=(E._STATE_AXES, 0))(s, t)
+        return s
+
+    def phase_ingest(s, t):
+        arr_rows, arr_n = packed
+        return jax.vmap(functools.partial(E._ingest_local, cfg=cfg,
+                                          to_delay=False),
+                        in_axes=(E._STATE_AXES, 0, 0, None),
+                        out_axes=E._STATE_AXES)(s, arr_rows, arr_n, t)
+
+    def phase_fifo(s, t):
+        s, _, _ = jax.vmap(functools.partial(E._fifo_local, cfg=cfg),
+                           in_axes=(E._STATE_AXES, None),
+                           out_axes=(E._STATE_AXES, 0, 0))(s, t)
+        return s
+
+    variants = {
+        "noop": [],
+        "release": [phase_release],
+        "release+ingest": [phase_release, phase_ingest],
+        "full": [phase_release, phase_ingest, phase_fifo],
+    }
+
+    for name, phases in variants.items():
+        def body(s, _):
+            t = s.t + cfg.tick_ms
+            for p in phases:
+                s = p(s, t)
+            return s.replace(t=t), None
+
+        fn = jax.jit(lambda s: jax.lax.scan(body, s, None, length=N)[0])
+        out = jax.block_until_ready(fn(state0))  # compile
+        walls = []
+        for _ in range(3):
+            t0 = time.time()
+            out = fn(state0)
+            np.asarray(out.t)
+            walls.append(time.time() - t0)
+        w = min(walls)
+        print(f"{name:18s} {w / N * 1e3:7.3f} ms/tick  "
+              f"placed={int(np.asarray(out.placed_total).sum())}")
+
+
+if __name__ == "__main__":
+    main()
