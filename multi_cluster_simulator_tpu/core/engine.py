@@ -284,8 +284,11 @@ def pack_arrivals_by_tick(arr: Arrivals, n_ticks: int,
                        np.zeros_like(t)], axis=-1)  # [C, A, NF]
     cc, aa = np.nonzero(ok)
     rows[dest[cc, aa], cc, rank[cc, aa]] = fields[cc, aa]
-    return st.TickArrivals(rows=jnp.asarray(rows),
-                           counts=jnp.asarray(counts2d.T[:n_ticks].copy()))
+    # host numpy, not device arrays: the bucketed tensor can be GBs at
+    # trace scale, and callers chunk/shard it — committing it to the
+    # default device here would hold a full extra HBM copy alive next to
+    # the per-chunk placements (jit transfers numpy leaves on use)
+    return st.TickArrivals(rows=rows, counts=counts2d.T[:n_ticks].copy())
 
 
 def _ingest_packed_local(s: SimState, rows: jax.Array, cnt: jax.Array, t,

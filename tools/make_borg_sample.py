@@ -33,7 +33,9 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 # BASELINE config's 4,096 clusters at ~250 jobs each — the same load density
 # as the borg4k synthetic, so the replay measures the engine, not a sparse
 # trace (the round-4 245k-instance sample left borg_replay at 59 jobs/cluster
-# and 112k jobs/s, 3x under borg4k purely on arrival density)
+# and 112k jobs/s, 3x under borg4k purely on arrival density). A 4x sample
+# was tried for a longer timed window and rejected: its tick-bucketed
+# arrival tensor alone needs ~6.7 GB of HBM (bench.py borg_replay docstring)
 N_COLLECTIONS = 150_000
 MEAN_INSTANCES = 6  # geometric; real collections are heavy-tailed too
 SPAN_US = 6 * 3600 * 1_000_000  # six trace-hours

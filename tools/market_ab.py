@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Sinkhorn vs greedy matcher A/B on the sinkhorn bench shape.
+
+Same workload, same engine, same config except ``trader.matching``:
+half the clusters are gpu-rich sellers, half gpu-poor buyers whose gpu
+jobs can only run on traded virtual nodes, at ~1.1x capacity saturation
+(the bench_sinkhorn shape, bench.py). Records, per matcher and cluster
+count: jobs placed (fraction), virtual nodes traded, mean avg-wait over
+clusters, and wall — the quantified basis for MARKET.md's claim that the
+entropic-OT matcher is (or is not) an upgrade over the reference's
+cheapest-approving-seller heap (trader.go:169-191,236-276).
+
+Run on the TPU: ``python tools/market_ab.py [--clusters 1024 4096]``.
+Writes a markdown table to stdout and JSON to tools/market_ab.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run_one(matching: str, C: int):
+    import jax
+
+    from bench import sinkhorn_market_setup  # the bench's exact shape
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.core.state import avg_wait_ms, init_state
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+
+    jobs_per = 400
+    cfg, specs, arrivals, n_ticks = sinkhorn_market_setup(
+        C, jobs_per, 600_000, matching=matching)
+    eng = Engine(cfg)
+    fn = jax.jit(eng.run, static_argnums=(2,))
+    state0 = init_state(cfg, specs)
+    out = jax.block_until_ready(fn(state0, arrivals, n_ticks))  # compile
+    t0 = time.time()
+    out = fn(state0, arrivals, n_ticks)
+    np.asarray(out.t)
+    wall = time.time() - t0
+    placed = int(np.asarray(out.placed_total).sum())
+    vnodes = int(np.asarray(out.node_active)[:, cfg.max_nodes:].sum())
+    waits = np.asarray(avg_wait_ms(out))
+    drops = total_drops(out)
+    return {"matching": matching, "clusters": C,
+            "placed": placed, "of": C * jobs_per,
+            "placed_frac": round(placed / (C * jobs_per), 4),
+            "virtual_nodes_traded": vnodes,
+            "mean_avg_wait_ms": round(float(waits.mean()), 1),
+            "p95_avg_wait_ms": round(float(np.percentile(waits, 95)), 1),
+            "wall_s": round(wall, 3), "drops": drops}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clusters", type=int, nargs="+", default=[1024, 4096])
+    args = ap.parse_args()
+    rows = []
+    for C in args.clusters:
+        for m in ("greedy", "sinkhorn"):
+            r = run_one(m, C)
+            rows.append(r)
+            print(f"# {m}@{C}: placed {r['placed_frac']:.4f}, "
+                  f"vnodes {r['virtual_nodes_traded']}, "
+                  f"wait {r['mean_avg_wait_ms']}ms, wall {r['wall_s']}s",
+                  file=sys.stderr)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "market_ab.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=2)
+    print("| clusters | matcher | placed frac | vnodes traded | "
+          "mean avg wait (ms) | p95 avg wait (ms) | wall (s) |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['clusters']} | {r['matching']} | {r['placed_frac']} | "
+              f"{r['virtual_nodes_traded']} | {r['mean_avg_wait_ms']} | "
+              f"{r['p95_avg_wait_ms']} | {r['wall_s']} |")
+
+
+if __name__ == "__main__":
+    main()

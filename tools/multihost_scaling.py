@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Multi-host (DCN) mesh cost curve: the scale16k shape at fixed total
+work, run over 1/2/4/8 jax.distributed processes (1 virtual CPU device
+each) on this host, recording wall per tick.
+
+Honest framing: this image has ONE physical CPU core (`nproc` = 1), so no
+process count can show real parallel speedup — every process time-slices
+the same core. What the curve DOES measure is the cost of the multi-host
+path itself: how much wall per tick the cross-process collectives
+(the borrow/trade exchanges + state sharding over DCN, parallel/multihost)
+add at fixed work as the mesh splits 1 -> 8 ways. Bounded overhead here is
+the evidence that the DCN path is viable; demonstrated *scaling* needs
+real multi-core/multi-host hardware, which tests/test_multihost.py's
+bit-exactness guarantee transfers to unchanged.
+
+Run: ``python tools/multihost_scaling.py`` (spawner; CPU-only).
+Writes a markdown table to stdout and JSON to tools/multihost_scaling.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_SELF = os.path.abspath(__file__)
+_ROOT = os.path.dirname(os.path.dirname(_SELF))
+
+C = 2048  # scale16k shape at 1/8 cluster count (one core must finish it)
+TICKS = 100
+JOBS_PER = 16
+
+
+def _worker(coordinator: str, pid: int, nprocs: int) -> None:
+    import jax
+
+    if nprocs > 1:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=nprocs, process_id=pid)
+    sys.path.insert(0, _ROOT)
+    import numpy as np
+
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.parallel import ShardedEngine, multihost
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+    # the _fifo_parity_scale config (bench.py) at reduced cluster count
+    cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=8, max_running=32,
+                    max_arrivals=JOBS_PER, max_ingest_per_tick=8, parity=True,
+                    n_res=2, max_nodes=5, max_virtual_nodes=0)
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    arrivals = uniform_stream(C, JOBS_PER, TICKS * 1000, max_cores=8,
+                              max_mem=6_000, max_dur_ms=60_000, seed=9)
+    state0 = init_state(cfg, specs)
+    if nprocs > 1:
+        mesh = multihost.global_mesh()
+        sh = ShardedEngine(cfg, mesh)
+        gstate, garr = multihost.shard_inputs_global(sh, state0, arrivals)
+        fn = sh.run_fn(TICKS)
+        out = jax.block_until_ready(fn(gstate, garr))  # compile
+        t0 = time.time()
+        out = jax.block_until_ready(fn(gstate, garr))
+        wall = time.time() - t0
+        placed = int(multihost.gather_to_host(out.placed_total).sum())
+    else:
+        fn = jax.jit(Engine(cfg).run, static_argnums=(2,))
+        out = jax.block_until_ready(fn(state0, arrivals, TICKS))
+        t0 = time.time()
+        out = jax.block_until_ready(fn(state0, arrivals, TICKS))
+        wall = time.time() - t0
+        placed = int(np.asarray(out.placed_total).sum())
+    if pid == 0:
+        print(f"RESULT {json.dumps({'nprocs': nprocs, 'wall_s': round(wall, 3), 'ms_per_tick': round(wall / TICKS * 1e3, 3), 'placed': placed})}",
+              flush=True)
+
+
+def _spawn(nprocs: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("JAX_PLATFORM_NAME", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "site" not in os.path.basename(p))
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    with tempfile.TemporaryDirectory() as td:
+        logs = [os.path.join(td, f"w{i}.log") for i in range(nprocs)]
+        handles = [open(l, "w") for l in logs]
+        procs = [subprocess.Popen(
+            [sys.executable, _SELF, "--worker", coordinator, str(i),
+             str(nprocs)],
+            stdout=handles[i], stderr=subprocess.STDOUT, text=True, env=env)
+            for i in range(nprocs)]
+        try:
+            for p in procs:
+                p.wait(timeout=1800)
+        finally:
+            for p in procs:
+                p.kill()
+            for h in handles:
+                h.close()
+        out0 = open(logs[0]).read()
+        for i, p in enumerate(procs):
+            assert p.returncode == 0, (
+                f"worker {i}/{nprocs} failed:\n{open(logs[i]).read()[-3000:]}")
+        for line in out0.splitlines():
+            if line.startswith("RESULT "):
+                return json.loads(line[len("RESULT "):])
+    raise RuntimeError(f"no RESULT from {nprocs}-process run:\n{out0[-2000:]}")
+
+
+def main():
+    rows = []
+    for n in (1, 2, 4, 8):
+        r = _spawn(n)
+        rows.append(r)
+        print(f"# {n} processes: {r['ms_per_tick']} ms/tick "
+              f"(placed {r['placed']})", file=sys.stderr)
+    with open(os.path.join(os.path.dirname(_SELF),
+                           "multihost_scaling.json"), "w") as f:
+        json.dump({"host_cores": os.cpu_count(), "clusters": C,
+                   "ticks": TICKS, "rows": rows}, f, indent=2)
+    print("| processes (1 device each) | wall (s) | ms/tick | "
+          "overhead vs 1-process |")
+    print("|---|---|---|---|")
+    base = rows[0]["wall_s"]
+    for r in rows:
+        print(f"| {r['nprocs']} | {r['wall_s']} | {r['ms_per_tick']} | "
+              f"{r['wall_s'] / base:.2f}x |")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 5 and sys.argv[1] == "--worker":
+        _worker(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+        sys.exit(0)
+    main()
