@@ -5,6 +5,8 @@
 # live in services/rpc.py over grpcio's generic handlers.
 set -e
 cd "$(dirname "$0")"
-protoc --python_out=. trader.proto resource_channel.proto
-# package-qualify the cross-file import for package-relative loading
-sed -i 's/^import trader_pb2 as trader__pb2$/from multi_cluster_simulator_tpu.services.proto import trader_pb2 as trader__pb2/' resource_channel_pb2.py
+protoc --python_out=. trader.proto resource_channel.proto \
+  otlp_common.proto otlp_resource.proto otlp_trace.proto otlp_metrics.proto \
+  otlp_trace_service.proto otlp_metrics_service.proto
+# package-qualify the cross-file imports for package-relative loading
+sed -i -E 's/^import (trader|resource_channel|otlp_[a-z_]+)_pb2 as (\S+)$/from multi_cluster_simulator_tpu.services.proto import \1_pb2 as \2/' ./*_pb2.py

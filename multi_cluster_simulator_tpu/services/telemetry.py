@@ -18,12 +18,20 @@ exist without an external collector dependency:
   (with # HELP/# TYPE) for a /metrics route.
 
 Ecosystem compatibility (the reference's env contract, telemetry.go:26-31):
-when ``OTEL_EXPORTER_OTLP_ENDPOINT`` is set, every Tracer batches spans to
-``<endpoint>/v1/traces`` and every Meter posts periodic snapshots to
-``<endpoint>/v1/metrics`` as OTLP/HTTP JSON (the protojson encoding any
-OpenTelemetry Collector ingests) — stdlib urllib, no SDK dependency. The
-JSONL paths stay the no-collector default, exactly like the reference run
-without a collector.
+when ``OTEL_EXPORTER_OTLP_ENDPOINT`` is set, every Tracer batches spans and
+every Meter posts periodic snapshots to the collector. The transport
+follows the standard ``OTEL_EXPORTER_OTLP_PROTOCOL`` selector:
+
+- ``grpc`` — the reference's transport (otlptracegrpc/otlpmetricgrpc,
+  telemetry.go:43-58,94-119): protobuf Export calls on
+  ``/opentelemetry.proto.collector.{trace,metrics}.v1.*Service/Export``
+  against a :4317-style gRPC collector, over stubs generated from the
+  transcribed OTLP schema (services/proto/otlp_*.proto).
+- ``http/json`` (default here) — protojson POSTs to
+  ``<endpoint>/v1/{traces,metrics}`` via stdlib urllib.
+
+The JSONL paths stay the no-collector default, exactly like the reference
+run without a collector.
 """
 
 from __future__ import annotations
@@ -42,11 +50,129 @@ TRACE_HEADER = "X-Trace-Context"  # traceparent analogue (HTTP)
 TRACE_METADATA_KEY = "x-trace-context"  # gRPC metadata (keys must be lowercase)
 
 OTLP_ENDPOINT_ENV = "OTEL_EXPORTER_OTLP_ENDPOINT"  # telemetry.go:28
+# Standard OTel transport selector: "grpc" exports over the reference's
+# transport (otlptracegrpc/otlpmetricgrpc, telemetry.go:43-58,94-119 —
+# what a gRPC-only collector on :4317 accepts); "http/json" (this
+# framework's default) posts protojson to <endpoint>/v1/{traces,metrics}.
+OTLP_PROTOCOL_ENV = "OTEL_EXPORTER_OTLP_PROTOCOL"
 
 
 def _otlp_endpoint() -> Optional[str]:
     ep = os.environ.get(OTLP_ENDPOINT_ENV, "").strip()
     return ep.rstrip("/") or None
+
+
+def _otlp_protocol() -> str:
+    return os.environ.get(OTLP_PROTOCOL_ENV, "http/json").strip() or "http/json"
+
+
+def _make_grpc_channel(endpoint: str):
+    """A long-lived channel to the collector; https:// selects TLS (a
+    plaintext channel to a TLS collector fails every handshake silently)."""
+    import grpc
+
+    secure = endpoint.startswith("https://")
+    target = endpoint
+    for scheme in ("http://", "https://", "grpc://"):
+        if target.startswith(scheme):
+            target = target[len(scheme):]
+            break
+    if secure:
+        return grpc.secure_channel(target, grpc.ssl_channel_credentials())
+    return grpc.insecure_channel(target)
+
+
+def _is_hex(s: str, n: int) -> bool:
+    if len(s) != n:
+        return False
+    try:
+        bytes.fromhex(s)
+        return True
+    except ValueError:
+        return False
+
+
+def _span_pb(span: dict):
+    """One OTLP Span protobuf from the internal JSON-shaped span dict.
+    Returns None for a span whose ids don't convert (a malformed propagated
+    context must not poison the whole batch — see start_span's validation,
+    the first line of defense)."""
+    from multi_cluster_simulator_tpu.services.proto import otlp_trace_pb2 as T
+
+    if not (_is_hex(span["traceId"], 32) and _is_hex(span["spanId"], 16)):
+        return None
+    pb = T.Span(trace_id=bytes.fromhex(span["traceId"]),
+                span_id=bytes.fromhex(span["spanId"]),
+                name=span["name"], kind=span.get("kind", 1),
+                start_time_unix_nano=int(span["startTimeUnixNano"]),
+                end_time_unix_nano=int(span["endTimeUnixNano"]))
+    parent = span.get("parentSpanId")
+    if parent and _is_hex(parent, 16):
+        pb.parent_span_id = bytes.fromhex(parent)
+    for kv in span.get("attributes", []):
+        a = pb.attributes.add(key=kv["key"])
+        v = kv["value"]
+        if "boolValue" in v:
+            a.value.bool_value = v["boolValue"]
+        elif "intValue" in v:
+            a.value.int_value = int(v["intValue"])
+        elif "doubleValue" in v:
+            a.value.double_value = v["doubleValue"]
+        else:
+            a.value.string_value = v.get("stringValue", "")
+    return pb
+
+
+def _grpc_export_spans(channel, service: str, batch: list[dict],
+                       timeout: float = 3.0) -> bool:
+    """Export over /opentelemetry.proto.collector.trace.v1.TraceService/
+    Export — the reference's transport. Never raises."""
+    try:
+        from multi_cluster_simulator_tpu.services.proto import (
+            otlp_trace_service_pb2 as TS,
+        )
+        req = TS.ExportTraceServiceRequest()
+        rs = req.resource_spans.add()
+        rs.resource.attributes.add(
+            key="service.name").value.string_value = service
+        ss = rs.scope_spans.add()
+        ss.scope.name = "multi_cluster_simulator_tpu"
+        for span in batch:
+            pb = _span_pb(span)
+            if pb is not None:
+                ss.spans.append(pb)
+        export = channel.unary_unary(
+            "/opentelemetry.proto.collector.trace.v1.TraceService/Export",
+            request_serializer=TS.ExportTraceServiceRequest.SerializeToString,
+            response_deserializer=TS.ExportTraceServiceResponse.FromString)
+        export(req, timeout=timeout)
+        return True
+    except Exception:
+        return False
+
+
+def _grpc_export_metrics(channel, payload: dict,
+                         timeout: float = 3.0) -> bool:
+    """Export the Meter's OTLP envelope over /opentelemetry.proto.collector.
+    metrics.v1.MetricsService/Export. ``otlp_payload()`` is already
+    protojson-shaped, so json_format.Parse does the whole conversion (and
+    cannot silently drop shapes a hand-rolled copier doesn't know)."""
+    try:
+        from google.protobuf import json_format
+
+        from multi_cluster_simulator_tpu.services.proto import (
+            otlp_metrics_service_pb2 as MS,
+        )
+        req = json_format.Parse(json.dumps(payload),
+                                MS.ExportMetricsServiceRequest())
+        export = channel.unary_unary(
+            "/opentelemetry.proto.collector.metrics.v1.MetricsService/Export",
+            request_serializer=MS.ExportMetricsServiceRequest.SerializeToString,
+            response_deserializer=MS.ExportMetricsServiceResponse.FromString)
+        export(req, timeout=timeout)
+        return True
+    except Exception:
+        return False
 
 
 def _otlp_post(url: str, payload: dict, timeout: float = 3.0) -> bool:
@@ -141,17 +267,25 @@ class Tracer:
 
     def __init__(self, service_name: str, path: Optional[str] = None,
                  otlp_endpoint: Optional[str] = None,
+                 otlp_protocol: Optional[str] = None,
                  flush_period_s: float = 2.0):
         self.service = service_name
         self.path = path
         # explicit "" opts out even when the env var is set
         self.otlp = (otlp_endpoint if otlp_endpoint is not None
                      else _otlp_endpoint()) or None
+        self.otlp_protocol = otlp_protocol or _otlp_protocol()
         self.flush_period_s = flush_period_s
         self._lock = threading.Lock()
         self._batch: list[dict] = []
         self._flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._channel = None  # lazily-built long-lived gRPC channel
+
+    def _grpc_channel(self):
+        if self._channel is None:
+            self._channel = _make_grpc_channel(self.otlp)
+        return self._channel
 
     @contextmanager
     def start_span(self, name: str, parent: Optional[str] = None, **attrs):
@@ -162,6 +296,12 @@ class Tracer:
         implicit context."""
         parent = parent or _CURRENT.get()
         trace_id, _, parent_id = (parent or "").partition(":")
+        # a malformed propagated header (non-hex / wrong length) must not
+        # enter the system: it would poison binary exports downstream
+        if not _is_hex(trace_id, 32):
+            trace_id, parent_id = "", ""
+        if not _is_hex(parent_id, 16):
+            parent_id = ""
         trace_id = trace_id or secrets.token_hex(16)
         span_id = secrets.token_hex(8)
         ctx = f"{trace_id}:{span_id}"
@@ -216,14 +356,18 @@ class Tracer:
             batch, self._batch = self._batch, []
         if not batch or self.otlp is None:
             return True
-        payload = {"resourceSpans": [{
-            "resource": {"attributes": [_kv("service.name", self.service)]},
-            "scopeSpans": [{
-                "scope": {"name": "multi_cluster_simulator_tpu"},
-                "spans": batch,
-            }],
-        }]}
-        if _otlp_post(self.otlp + "/v1/traces", payload):
+        if self.otlp_protocol == "grpc":
+            ok = _grpc_export_spans(self._grpc_channel(), self.service, batch)
+        else:
+            payload = {"resourceSpans": [{
+                "resource": {"attributes": [_kv("service.name", self.service)]},
+                "scopeSpans": [{
+                    "scope": {"name": "multi_cluster_simulator_tpu"},
+                    "spans": batch,
+                }],
+            }]}
+            ok = _otlp_post(self.otlp + "/v1/traces", payload)
+        if ok:
             return True
         with self._lock:
             self._batch = (batch + self._batch)[-4096:]
@@ -236,6 +380,9 @@ class Tracer:
             self._flusher = None
         else:
             self.flush()
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
 
 
 class Meter:
@@ -249,18 +396,21 @@ class Meter:
 
     def __init__(self, service_name: str, export_path: Optional[str] = None,
                  export_period_s: float = 5.0,
-                 otlp_endpoint: Optional[str] = None):
+                 otlp_endpoint: Optional[str] = None,
+                 otlp_protocol: Optional[str] = None):
         self.service = service_name
         self.export_path = export_path
         self.export_period_s = export_period_s
         self.otlp = (otlp_endpoint if otlp_endpoint is not None
                      else _otlp_endpoint()) or None  # "" opts out
+        self.otlp_protocol = otlp_protocol or _otlp_protocol()
         self._counters: dict[str, float] = {}
         self._hists: dict[str, list[int]] = {}
         self._hist_sum: dict[str, float] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._channel = None  # lazily-built long-lived gRPC channel
 
     def add(self, name: str, value: float) -> None:
         """Up/down counter add (Int64UpDownCounter.Add)."""
@@ -339,9 +489,14 @@ class Meter:
         }]}
 
     def export_otlp(self) -> bool:
-        """Push the current snapshot to the configured collector."""
+        """Push the current snapshot to the configured collector over the
+        configured transport (grpc or http/json)."""
         if self.otlp is None:
             return True
+        if self.otlp_protocol == "grpc":
+            if self._channel is None:
+                self._channel = _make_grpc_channel(self.otlp)
+            return _grpc_export_metrics(self._channel, self.otlp_payload())
         return _otlp_post(self.otlp + "/v1/metrics", self.otlp_payload())
 
     def start_exporter(self) -> None:
@@ -367,3 +522,6 @@ class Meter:
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None

@@ -254,3 +254,122 @@ def test_prometheus_rendering_is_conformant():
     assert "# TYPE svc_waitTime histogram" in text
     assert 'svc_waitTime_bucket{le="50"} 1' in text
     assert "svc_waitTime_count 1" in text
+
+
+class MockGrpcCollector:
+    """Minimal OTLP/gRPC collector: serves the real
+    /opentelemetry.proto.collector.{trace,metrics}.v1.*Service/Export
+    methods (the reference's deployment assumption — a :4317 gRPC-only
+    collector, internal/service/telemetry.go:43-58) and records decoded
+    requests."""
+
+    def __init__(self):
+        import threading
+
+        import grpc
+
+        from multi_cluster_simulator_tpu.services.proto import (
+            otlp_metrics_service_pb2 as MS,
+            otlp_trace_service_pb2 as TS,
+        )
+        self.trace_requests = []
+        self.metric_requests = []
+        self._lock = threading.Lock()
+
+        def export_traces(req, context):
+            with self._lock:
+                self.trace_requests.append(req)
+            return TS.ExportTraceServiceResponse()
+
+        def export_metrics(req, context):
+            with self._lock:
+                self.metric_requests.append(req)
+            return MS.ExportMetricsServiceResponse()
+
+        from concurrent import futures
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self.server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                "opentelemetry.proto.collector.trace.v1.TraceService", {
+                    "Export": grpc.unary_unary_rpc_method_handler(
+                        export_traces,
+                        request_deserializer=TS.ExportTraceServiceRequest.FromString,
+                        response_serializer=TS.ExportTraceServiceResponse.SerializeToString)}),
+            grpc.method_handlers_generic_handler(
+                "opentelemetry.proto.collector.metrics.v1.MetricsService", {
+                    "Export": grpc.unary_unary_rpc_method_handler(
+                        export_metrics,
+                        request_deserializer=MS.ExportMetricsServiceRequest.FromString,
+                        response_serializer=MS.ExportMetricsServiceResponse.SerializeToString)}),
+        ))
+        port = self.server.add_insecure_port("127.0.0.1:0")
+        self.target = f"127.0.0.1:{port}"
+        self.server.start()
+
+    def stop(self):
+        self.server.stop(None)
+
+
+def test_otlp_grpc_export():
+    """OTEL_EXPORTER_OTLP_PROTOCOL=grpc exports spans and metrics over the
+    reference's transport: protobuf Export RPCs a gRPC-only collector
+    accepts, with ids as raw bytes and histograms as explicit-bounds
+    cumulative points."""
+    from multi_cluster_simulator_tpu.services.telemetry import Meter, Tracer
+
+    col = MockGrpcCollector()
+    try:
+        tr = Tracer("svc-grpc", otlp_endpoint=col.target,
+                    otlp_protocol="grpc", flush_period_s=0.2)
+        with tr.start_span("parent", job_id=7):
+            with tr.start_span("child"):
+                pass
+        assert tr.flush(), "grpc span export failed"
+        assert col.trace_requests
+        req = col.trace_requests[0]
+        rs = req.resource_spans[0]
+        assert rs.resource.attributes[0].key == "service.name"
+        assert rs.resource.attributes[0].value.string_value == "svc-grpc"
+        spans = {s.name: s for s in rs.scope_spans[0].spans}
+        assert set(spans) == {"parent", "child"}
+        assert len(spans["parent"].trace_id) == 16
+        assert len(spans["parent"].span_id) == 8
+        # causality survives the binary encoding
+        assert spans["child"].parent_span_id == spans["parent"].span_id
+        assert spans["child"].trace_id == spans["parent"].trace_id
+        assert spans["parent"].attributes[0].key == "job_id"
+        assert spans["parent"].attributes[0].value.int_value == 7
+        assert spans["parent"].end_time_unix_nano >= \
+            spans["parent"].start_time_unix_nano
+
+        m = Meter("svc-grpc", otlp_endpoint=col.target, otlp_protocol="grpc")
+        m.add("jobs_in_queue", 3)
+        m.record("waitTime", 120.0)
+        assert m.export_otlp(), "grpc metric export failed"
+        assert col.metric_requests
+        metrics = {mm.name: mm for mm in
+                   col.metric_requests[0].resource_metrics[0]
+                   .scope_metrics[0].metrics}
+        s = metrics["svc-grpc_jobs_in_queue"].sum
+        assert not s.is_monotonic and s.aggregation_temporality == 2
+        assert s.data_points[0].as_double == 3.0
+        h = metrics["svc-grpc_waitTime"].histogram
+        dp = h.data_points[0]
+        assert dp.count == 1 and dp.sum == 120.0
+        assert list(dp.explicit_bounds) == [10, 50, 100, 500, 1_000, 5_000,
+                                            10_000, 60_000, 300_000]
+        assert sum(dp.bucket_counts) == 1
+        # a malformed propagated context (e.g. a garbage X-Trace-Context
+        # header) must neither poison the batch nor crash the export:
+        # start_span discards the bad ids and mints fresh valid ones
+        with tr.start_span("resilient", parent="abc:xyz"):
+            pass
+        assert tr.flush(), "export after malformed propagation failed"
+        names = [sp.name for req in col.trace_requests
+                 for rs in req.resource_spans
+                 for ss in rs.scope_spans for sp in ss.spans]
+        assert "resilient" in names
+        tr.shutdown()
+        m.stop_exporter()
+    finally:
+        col.stop()
