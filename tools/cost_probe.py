@@ -36,7 +36,7 @@ def shapes():
     # n_ticks/full_ticks so the probe's per-tick load density matches the
     # bench config it models
     yield "headline_fifo_4k", SimConfig(
-        policy=PolicyKind.FIFO, queue_capacity=24, max_running=32,
+        policy=PolicyKind.FIFO, queue_capacity=8, max_running=32,
         max_arrivals=250, max_ingest_per_tick=8, parity=True, n_res=2,
         max_nodes=5, max_virtual_nodes=0), 4096, 250, 1570
     # both FFD sweep forms, so the JSON keeps carrying the serial-vs-wave

@@ -23,7 +23,7 @@ def main():
     from multi_cluster_simulator_tpu.workload.traces import uniform_stream
 
     C, jobs_per, horizon_ms = 4096, 250, 1_500_000
-    cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=24, max_running=32,
+    cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=8, max_running=32,
                     max_arrivals=jobs_per, max_ingest_per_tick=8,
                     parity=True, n_res=2, max_nodes=5, max_virtual_nodes=0)
     specs = [uniform_cluster(c + 1, 5) for c in range(C)]
