@@ -429,10 +429,13 @@ def sinkhorn_market_setup(C, jobs_per, horizon_ms, matching="sinkhorn",
                     # 4 slots covers the measured per-cluster win maximum
                     # (the vslot drop counter is the guard)
                     max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=4,
-                    # the 8-wide sweep leaves the wave form nothing to
-                    # parallelize (A/B: serial 6.59s vs wave 6.78s min) —
-                    # the market, not the sweep, dominates this config
-                    delay_sweep="serial",
+                    # wave with the r5 group-fit acceptance: 4.58s vs
+                    # serial's 6.16s, identical placements and trades
+                    # (the pre-group-rule A/B had wave losing 6.78 vs
+                    # 6.59 — distinct-target waves bought nothing on
+                    # homogeneous nodes). The market itself is ~12% of
+                    # the config's wall (market-off probe 4.90 vs 5.54).
+                    delay_sweep="wave",
                     trader=TraderConfig(enabled=True,
                                         matching=MatchKind(matching),
                                         carve_mode="sane"))
