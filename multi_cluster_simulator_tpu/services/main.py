@@ -96,11 +96,21 @@ def main(argv=None) -> None:
     ap.add_argument("--registry", default=f"http://127.0.0.1:{REGISTRY_PORT}")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    p = sub.add_parser("registry")
+    def add_parser(name):
+        # accept the global flags AFTER the subcommand too ("main scheduler
+        # x.json --speed 200"); SUPPRESS keeps the top-level defaults in
+        # force when the trailing flag is absent
+        p = sub.add_parser(name)
+        p.add_argument("--speed", type=float, default=argparse.SUPPRESS,
+                       help="virtual-time speedup (1.0 = reference real-time)")
+        p.add_argument("--registry", default=argparse.SUPPRESS)
+        return p
+
+    p = add_parser("registry")
     p.add_argument("--port", type=int, default=REGISTRY_PORT)
     p.set_defaults(fn=cmd_registry)
 
-    p = sub.add_parser("scheduler")
+    p = add_parser("scheduler")
     p.add_argument("cluster_json")
     p.add_argument("--name", default="Scheduler")
     p.add_argument("--policy", default="DELAY", choices=["FIFO", "DELAY", "FFD"])
@@ -110,18 +120,18 @@ def main(argv=None) -> None:
                         "start (queued/running work survives restarts)")
     p.set_defaults(fn=cmd_scheduler)
 
-    p = sub.add_parser("trader")
+    p = add_parser("trader")
     p.add_argument("scheduler_rpc", help="scheduler gRPC host:port")
     p.add_argument("--name", default="Trader")
     p.set_defaults(fn=cmd_trader)
 
-    p = sub.add_parser("client")
+    p = add_parser("client")
     p.add_argument("scheduler_url")
     p.add_argument("--name", default="Client")
     p.add_argument("--max-jobs", type=int, default=None)
     p.set_defaults(fn=cmd_client)
 
-    p = sub.add_parser("log")
+    p = add_parser("log")
     p.add_argument("destination", nargs="?", default="./grading.log")
     p.add_argument("--port", type=int, default=0)
     p.set_defaults(fn=cmd_log)
