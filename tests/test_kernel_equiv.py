@@ -357,3 +357,76 @@ class TestTickIndexedArrivals:
         b = sh.run_fn(n_ticks, tick_indexed=True)(s_sh, ta_sh)
         for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
             np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+class TestTickIndexedFuzz:
+    """pack_arrivals_by_tick vs the windowed stream path on adversarial
+    streams: exact-boundary arrival times (ta == k*tick_ms), t=0 arrivals,
+    single-tick bursts, beyond-horizon arrivals (never ingested by either
+    path), and idle clusters — the edges where a bucketing off-by-one
+    would hide."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_adversarial_streams(self, seed):
+        import jax
+        import jax.numpy as jnp
+
+        from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+        from multi_cluster_simulator_tpu.core.engine import (
+            Engine, pack_arrivals_by_tick,
+        )
+        from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+        from multi_cluster_simulator_tpu.core.state import Arrivals, init_state
+
+        rng = np.random.default_rng(seed)
+        C, A, n_ticks = 4, 64, 120
+        t = np.zeros((C, A), np.int64)
+        n = np.zeros((C,), np.int32)
+        for c in range(C):
+            if c == 3:
+                n[c] = 0  # idle cluster
+                continue
+            kind = (seed + c) % 3
+            if kind == 0:  # exact tick boundaries incl. 0 and the horizon
+                times = rng.choice(np.arange(0, (n_ticks + 4) * 1000, 1000),
+                                   size=A, replace=True)
+            elif kind == 1:  # one-tick burst
+                times = np.full(A, 7_500) + rng.integers(0, 3, A)
+            else:  # arbitrary, some beyond horizon
+                times = rng.integers(0, (n_ticks + 40) * 1000, A)
+            n[c] = A
+            t[c] = np.sort(times)
+        arr = Arrivals(
+            t=jnp.asarray(t.astype(np.int32)),
+            id=jnp.asarray(np.arange(1, C * A + 1, dtype=np.int32).reshape(C, A)),
+            cores=jnp.asarray(rng.integers(1, 8, (C, A)).astype(np.int32)),
+            mem=jnp.asarray(rng.integers(1, 4000, (C, A)).astype(np.int32)),
+            gpu=jnp.zeros((C, A), jnp.int32),
+            dur=jnp.asarray((rng.integers(0, 20, (C, A)) * 1000).astype(np.int32)),
+            n=jnp.asarray(n))
+        cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=128,
+                        max_running=128, max_arrivals=A,
+                        max_ingest_per_tick=A, parity=True, n_res=2,
+                        max_nodes=5, max_virtual_nodes=0, record_trace=True)
+        eng = Engine(cfg)
+        s0 = init_state(cfg, [uniform_cluster(c + 1, 5) for c in range(C)])
+        a = eng.run_jit()(s0, arr, n_ticks)
+        ta = pack_arrivals_by_tick(arr, n_ticks, cfg.tick_ms)
+        b = eng.run_jit()(s0, ta, n_ticks)
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_unsorted_stream_rejected(self):
+        import jax.numpy as jnp
+
+        from multi_cluster_simulator_tpu.core.engine import (
+            pack_arrivals_by_tick,
+        )
+        from multi_cluster_simulator_tpu.core.state import Arrivals
+
+        z = jnp.zeros((1, 3), jnp.int32)
+        arr = Arrivals(t=jnp.asarray([[5_000, 2_000, 9_000]], jnp.int32),
+                       id=jnp.asarray([[1, 2, 3]], jnp.int32), cores=z,
+                       mem=z, gpu=z, dur=z, n=jnp.asarray([3], jnp.int32))
+        with pytest.raises(ValueError, match="time-sorted"):
+            pack_arrivals_by_tick(arr, 10, 1000)
