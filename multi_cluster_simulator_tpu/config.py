@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Optional
 
 
 class PolicyKind(str, enum.Enum):

@@ -39,8 +39,6 @@ concurrent goroutines — see PARITY.md):
 from __future__ import annotations
 
 import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -19,7 +19,6 @@ indices in traces are directly comparable.
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Optional
 
 import numpy as np

@@ -79,7 +79,7 @@ class RegistryServer:
                  heartbeat_period_s: float = HEARTBEAT_PERIOD_S,
                  speed: float = 1.0, logger=None):
         self._regs: list[ServiceRegistration] = []
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()  # guards: _regs
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self.heartbeat_period_s = heartbeat_period_s / speed
@@ -213,7 +213,7 @@ class RegistryClient:
                  logger=None,
                  on_update: Optional[Callable[[dict], None]] = None):
         self._providers: dict[str, list[str]] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _providers
         self.registry_url = registry_url
         self.server = server
         self.logger = logger

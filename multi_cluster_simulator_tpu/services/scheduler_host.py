@@ -95,7 +95,7 @@ class SchedulerService(Service):
         self.cfg = cfg
         self.engine = Engine(cfg)
         self._tick_fn = jax.jit(self.engine.tick_io)
-        self._slock = threading.RLock()  # guards state + arrival buffer
+        self._slock = threading.RLock()  # guards: state, _arr, _arr_n, _journal, _owner_urls, _owner_idx
         self.state = init_state(cfg, [spec])
         # host-side arrival staging ring ([1, A] to match the engine shapes)
         A = cfg.max_arrivals
@@ -106,7 +106,7 @@ class SchedulerService(Service):
         # the tick thread drains it (so an in-flight compile or device step
         # never blocks the HTTP surface)
         self._pending: list[tuple] = []
-        self._plock = threading.Lock()
+        self._plock = threading.Lock()  # guards: _pending
         # mutation journal: a list while a tick's device call is in flight
         # (handlers' state ops are replayed onto the tick result at swap
         # time — see _mutate/_tick_once), None otherwise
@@ -282,7 +282,7 @@ class SchedulerService(Service):
         with self._plock:
             self._pending.append((jid, cores, mem, dur_ms, delay))
 
-    def _drain_pending(self) -> None:
+    def _drain_pending(self) -> None:  # holds: _slock
         """Move submitted jobs into the engine, timestamped at the current
         virtual time. Caller holds the state lock.
 
@@ -318,9 +318,9 @@ class SchedulerService(Service):
             self._arr["dur"][0, i] = dur_ms
             self._arr_n += 1
 
-    def _compact_arrivals(self) -> None:
+    def _compact_arrivals(self) -> None:  # holds: _slock
         """Drop the consumed prefix of the ring and rebase the device
-        cursor (host_ops.rebase_arrivals)."""
+        cursor (host_ops.rebase_arrivals). Caller holds the state lock."""
         consumed = int(np.asarray(self.state.arr_ptr)[0])
         if consumed <= 0:
             return
@@ -329,7 +329,7 @@ class SchedulerService(Service):
         self._arr_n -= consumed
         self.state = host_ops.rebase_arrivals(self.state, consumed)
 
-    def _arrivals_device(self) -> Arrivals:
+    def _arrivals_device(self) -> Arrivals:  # holds: _slock
         return Arrivals(
             t=self._arr["t"], id=self._arr["id"], cores=self._arr["cores"],
             mem=self._arr["mem"], gpu=self._arr["gpu"], dur=self._arr["dur"],
@@ -397,17 +397,20 @@ class SchedulerService(Service):
 
     def _warmup(self) -> None:
         """Compile the tick and the handler-path host ops before serving
-        traffic, so no HTTP request ever waits on an XLA compile."""
+        traffic, so no HTTP request ever waits on an XLA compile. The HTTP
+        surface is already up when on_start runs, so even this read-only
+        pass takes the state lock."""
         import jax
-        jax.block_until_ready(
-            self._tick_fn(self.state, self._arrivals_device()))  # discarded
-        vec = Q.JobRec.make(id=0, cores=1, mem=1, dur=1).vec
-        host_ops.lend_feasible(self.state, 1, 1)
-        host_ops.push_lent(self.state, vec)
-        host_ops.remove_borrowed(self.state, vec)
-        host_ops.commit_borrow(self.state, vec)
-        host_ops.push_ready(self.state, vec)
-        host_ops.push_l0(self.state, vec)
+        with self._slock:
+            jax.block_until_ready(
+                self._tick_fn(self.state, self._arrivals_device()))  # discarded
+            vec = Q.JobRec.make(id=0, cores=1, mem=1, dur=1).vec
+            host_ops.lend_feasible(self.state, 1, 1)
+            host_ops.push_lent(self.state, vec)
+            host_ops.remove_borrowed(self.state, vec)
+            host_ops.commit_borrow(self.state, vec)
+            host_ops.push_ready(self.state, vec)
+            host_ops.push_l0(self.state, vec)
 
     def _tick_loop(self) -> None:
         period = self.cfg.tick_ms / 1000.0 / self.speed
@@ -464,14 +467,18 @@ class SchedulerService(Service):
     def _process_returns(self, io) -> None:
         """POST each finished foreign job back to its borrower's /lent,
         up to 3 attempts (ReturnToBorrower, server.go:260-290)."""
+        # the borrower table grows from handler threads (under _slock);
+        # snapshot it once instead of indexing it race-ily per message
+        with self._slock:
+            owner_urls = list(self._owner_urls)
         for m in range(io.ret_valid.shape[1]):
             if not io.ret_valid[0, m]:
                 continue
             row = io.ret_rows[0, m]
             owner = int(row[R.ROWNER])
-            if not (1 <= owner < len(self._owner_urls)):
+            if not (1 <= owner < len(owner_urls)):
                 continue
-            url = self._owner_urls[owner]
+            url = owner_urls[owner]
             payload = job_to_json(row[R.RID], row[R.RCORES], row[R.RMEM],
                                   row[R.RDUR], ownership=url)
             self._pool.submit(telemetry.wrap_ctx(self._post_return),
@@ -523,7 +530,7 @@ class SchedulerService(Service):
                                      int(job.id), futs[fut])
                     break
 
-    def _intern_owner(self, url: str) -> int:
+    def _intern_owner(self, url: str) -> int:  # holds: _slock
         if url not in self._owner_idx:
             self._owner_idx[url] = len(self._owner_urls)
             self._owner_urls.append(url)

@@ -276,16 +276,18 @@ class Tracer:
                      else _otlp_endpoint()) or None
         self.otlp_protocol = otlp_protocol or _otlp_protocol()
         self.flush_period_s = flush_period_s
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _batch, _flusher, _channel
         self._batch: list[dict] = []
         self._flusher: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._channel = None  # lazily-built long-lived gRPC channel
 
     def _grpc_channel(self):
-        if self._channel is None:
-            self._channel = _make_grpc_channel(self.otlp)
-        return self._channel
+        # raced by the periodic flusher thread and shutdown's final flush
+        with self._lock:
+            if self._channel is None:
+                self._channel = _make_grpc_channel(self.otlp)
+            return self._channel
 
     @contextmanager
     def start_span(self, name: str, parent: Optional[str] = None, **attrs):
@@ -332,11 +334,13 @@ class Tracer:
                     self._start_flusher_locked()
 
     # -- OTLP batching (BatchSpanProcessor analogue) --
-    def _start_flusher_locked(self) -> None:
+    def _start_flusher_locked(self) -> None:  # holds: _lock
         """Spawn the periodic flusher once; caller holds self._lock (the
         check and the assignment must be atomic or two first-span threads
         each spawn one)."""
-        if self._flusher is not None:
+        # a span ending concurrently with shutdown() must not resurrect the
+        # flusher (and with it a gRPC channel nothing would ever close)
+        if self._flusher is not None or self._stop.is_set():
             return
 
         def loop():
@@ -375,14 +379,18 @@ class Tracer:
 
     def shutdown(self) -> None:
         self._stop.set()
-        if self._flusher is not None:
-            self._flusher.join(timeout=3)  # its exit path flushes
-            self._flusher = None
+        # take ownership under the lock, then join/close outside it (the
+        # flusher's exit path flushes, which takes the lock itself)
+        with self._lock:
+            flusher, self._flusher = self._flusher, None
+        if flusher is not None:
+            flusher.join(timeout=3)  # its exit path flushes
         else:
             self.flush()
-        if self._channel is not None:
-            self._channel.close()
-            self._channel = None
+        with self._lock:
+            channel, self._channel = self._channel, None
+        if channel is not None:
+            channel.close()
 
 
 class Meter:
@@ -407,7 +415,7 @@ class Meter:
         self._counters: dict[str, float] = {}
         self._hists: dict[str, list[int]] = {}
         self._hist_sum: dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _counters, _hists, _hist_sum, _thread, _channel
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._channel = None  # lazily-built long-lived gRPC channel
@@ -494,16 +502,18 @@ class Meter:
         if self.otlp is None:
             return True
         if self.otlp_protocol == "grpc":
-            if self._channel is None:
-                self._channel = _make_grpc_channel(self.otlp)
-            return _grpc_export_metrics(self._channel, self.otlp_payload())
+            # raced by the exporter thread and the final shutdown export
+            with self._lock:
+                if self._channel is None:
+                    self._channel = _make_grpc_channel(self.otlp)
+                channel = self._channel
+            return _grpc_export_metrics(channel, self.otlp_payload())
         return _otlp_post(self.otlp + "/v1/metrics", self.otlp_payload())
 
     def start_exporter(self) -> None:
         """PeriodicReader analogue: append snapshots to export_path and/or
         push them to the OTLP collector every period."""
-        if (self.export_path is None and self.otlp is None) \
-                or self._thread is not None:
+        if self.export_path is None and self.otlp is None:
             return
 
         def loop():
@@ -513,15 +523,21 @@ class Meter:
                         f.write(json.dumps(self.snapshot()) + "\n")
                 self.export_otlp()
 
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name=f"meter:{self.service}")
-        self._thread.start()
+        th = threading.Thread(target=loop, daemon=True,
+                              name=f"meter:{self.service}")
+        with self._lock:  # the once-check and the publish must be atomic
+            if self._thread is not None:
+                return
+            self._thread = th
+        th.start()
 
     def stop_exporter(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
-            self._thread = None
-        if self._channel is not None:
-            self._channel.close()
-            self._channel = None
+        with self._lock:
+            th, self._thread = self._thread, None
+        if th is not None:
+            th.join(timeout=2)
+        with self._lock:
+            channel, self._channel = self._channel, None
+        if channel is not None:
+            channel.close()

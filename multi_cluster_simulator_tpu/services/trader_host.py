@@ -73,13 +73,16 @@ class TraderService(Service):
         self.grpc_addr: Optional[str] = None
         self.sched: Optional[rpc.ResourceChannelClient] = None
         # cached clusterState mirror (trader.go:71-108)
-        self._cs_lock = threading.Lock()
+        self._cs_lock = threading.Lock()  # guards: _cs
         self._cs = {"cores_util": 0.0, "mem_util": 0.0,
                     "total_cpu": 0, "total_mem": 0, "avg_wait_ms": 0.0}
         # seller side (trader/server.go:14-29)
-        self._sell_lock = threading.Lock()
+        self._sell_lock = threading.Lock()  # guards: _current, _serial
         self._current: Optional[t_pb.ContractResponse] = None
         self._serial = random.getrandbits(31) or 1  # s.id = rand.Uint32()
+        # peer cache + trade counters are shared between the monitor thread,
+        # gRPC handler threads, and shutdown
+        self._peer_lock = threading.Lock()  # guards: _peer_clients, trades_won, trades_sold
         self._peer_clients: dict[str, rpc.TraderClient] = {}
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix=f"{name}-rpc")
@@ -107,7 +110,9 @@ class TraderService(Service):
             self._grpc_server.stop(grace=1)
         if self.sched is not None:
             self.sched.close()
-        for c in self._peer_clients.values():
+        with self._peer_lock:
+            clients = list(self._peer_clients.values())
+        for c in clients:
             c.close()
         for th in self._threads:
             th.join(timeout=5)
@@ -244,17 +249,20 @@ class TraderService(Service):
             except Exception as e:
                 self.logger.error("receive_virtual_node failed: %r", e)
                 return False
-            self.trades_won += 1
+            with self._peer_lock:
+                self.trades_won += 1
             self.logger.info("trade won: %d cores / %d MB from %s",
                              node.cores, node.memory, url)
             return True
         return False
 
     def _peer(self, url: str) -> rpc.TraderClient:
-        """Lazily-built peer client cache (TraderClients, trader.go:33)."""
-        if url not in self._peer_clients:
-            self._peer_clients[url] = rpc.TraderClient(url)
-        return self._peer_clients[url]
+        """Lazily-built peer client cache (TraderClients, trader.go:33);
+        raced by the monitor thread and shutdown."""
+        with self._peer_lock:
+            if url not in self._peer_clients:
+                self._peer_clients[url] = rpc.TraderClient(url)
+            return self._peer_clients[url]
 
     # ------------------------------------------------------------------
     # seller: gRPC Trader service (trader/server.go:31-85)
@@ -313,5 +321,6 @@ class TraderService(Service):
                 node = self.sched.provide_virtual_node(req)
             finally:
                 self._current = None  # reset for future activity
-            self.trades_sold += 1
+            with self._peer_lock:  # always inner to _sell_lock
+                self.trades_sold += 1
             return node

@@ -46,6 +46,9 @@ class WorkloadClientService(Service):
         self.jobs_sent = 0
         self.acks = 0
         self._rng = np.random.default_rng(wcfg.seed)
+        # the ack counter is bumped by HTTP handler threads and read by the
+        # generator thread / tests
+        self._ack_lock = threading.Lock()  # guards: acks
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -54,7 +57,8 @@ class WorkloadClientService(Service):
         self.httpd.route("GET", "/jobAdded", self._handle_ack)
 
     def _handle_ack(self, body: bytes, headers: dict):
-        self.acks += 1  # the "ack!" print (client/server.go:27-31)
+        with self._ack_lock:  # handler threads race each other here
+            self.acks += 1  # the "ack!" print (client/server.go:27-31)
         return 200, None
 
     def on_start(self) -> None:

@@ -51,7 +51,7 @@ def generate_arrivals(
     out_n = np.zeros((C,), np.int32)
 
     for c in range(C):
-        rng = np.random.Generator(np.random.PCG64([seed, c]))
+        rng = np.random.Generator(np.random.PCG64([seed, c]))  # simlint: ignore[det-wallclock] -- explicitly seeded per-cluster substream: replay-deterministic by construction
         times_ms: list[int] = []
         if cfg.arrival == "poisson":
             minute = 0

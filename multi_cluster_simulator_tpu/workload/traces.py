@@ -38,6 +38,8 @@ def uniform_stream(n_clusters: int, jobs_per_cluster: int, horizon_ms: int,
     family, client.go:87-99); uniform durations. With ``max_gpus > 0``, a
     ``gpu_frac`` fraction of jobs additionally request 1..max_gpus
     accelerators (the 3-dim-resource workload of BASELINE config 4)."""
+    # simlint: ignore[det-wallclock] -- explicitly seeded: the same seed
+    # reproduces the same stream bit-for-bit
     rng = np.random.Generator(np.random.PCG64(seed))
     C, A = n_clusters, jobs_per_cluster
     t = rng.integers(0, horizon_ms, (C, A))
@@ -54,6 +56,8 @@ def uniform_stream(n_clusters: int, jobs_per_cluster: int, horizon_ms: int,
 def borg_like_stream(n_clusters: int, jobs_per_cluster: int, horizon_ms: int,
                      max_cores: int, max_mem: int, seed: int = 0) -> Arrivals:
     """Borg-2019-shaped synthetic trace (heavy tails + diurnal arrivals)."""
+    # simlint: ignore[det-wallclock] -- explicitly seeded: the same seed
+    # reproduces the same stream bit-for-bit
     rng = np.random.Generator(np.random.PCG64(seed))
     C, A = n_clusters, jobs_per_cluster
     # diurnal arrival times by inverse-CDF of 1 + 0.6*sin(2*pi*t/day)

@@ -1,0 +1,14 @@
+"""Known-bad: unordered iteration in tick-path code."""
+
+
+def drain(pending_ids):
+    done = set()
+    for jid in {3, 1, 2}:  # BAD: set-literal iteration
+        done.add(jid)
+    for jid in set(pending_ids):  # BAD: set() iteration
+        done.add(jid)
+    for jid in done:  # BAD: iterating a set local
+        pass
+    for jid in sorted(done):  # ok: sorted
+        pass
+    return done

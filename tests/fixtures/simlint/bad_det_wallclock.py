@@ -1,0 +1,7 @@
+"""Known-bad: wall-clock read in tick-path code (jitted or not)."""
+import time
+
+
+def market_round(state):
+    stamp = time.time()  # BAD: replay would diverge
+    return state, stamp

@@ -1,0 +1,23 @@
+"""Known-bad: guarded attribute touched outside its lock."""
+import threading
+
+
+class Host:
+    def __init__(self):
+        self._lock = threading.Lock()  # guards: count, items
+        self.count = 0
+        self.items = []
+
+    def handler(self):
+        self.count += 1  # BAD: write outside `with self._lock`
+
+    def snapshot(self):
+        with self._lock:
+            n = self.count  # ok
+        return n, len(self.items)  # BAD: read outside the lock
+
+    def _drain(self):  # holds: _lock
+        self.items.clear()  # ok: caller-held lock, annotated
+
+    def flusher(self):
+        self._drain()  # BAD: calls a holds-annotated method lockless

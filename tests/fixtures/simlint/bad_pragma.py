@@ -1,0 +1,12 @@
+"""Known-bad pragma usage: reasonless suppression + stale pragma."""
+import time
+
+
+def market_round(state):
+    stamp = time.time()  # simlint: ignore[det-wallclock]
+    return state, stamp
+
+
+def clean(state):
+    # simlint: ignore[det-unordered-iter] -- nothing here iterates a set
+    return state

@@ -1,0 +1,14 @@
+"""Known-bad: Python control flow on a traced value inside jitted code."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def schedule(state, budget):
+    total = jnp.sum(state)
+    if total > budget:  # BAD: traced branch
+        return state - 1
+    while total > 0:  # BAD: traced loop
+        total = total - 1
+    assert total == 0  # BAD: traced assert
+    return state

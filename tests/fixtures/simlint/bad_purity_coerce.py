@@ -1,0 +1,12 @@
+"""Known-bad: host coercion of traced values inside jitted code."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def summarize(state):
+    total = jnp.sum(state)
+    n = int(total)  # BAD: device sync inside the trace
+    frac = float(state[0])  # BAD
+    first = state[0].item()  # BAD
+    return n + frac + first

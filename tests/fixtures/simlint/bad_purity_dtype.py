@@ -1,0 +1,10 @@
+"""Known-bad: 64-bit dtype leaks into the int32-disciplined engine."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def widen(state):
+    acc = jnp.zeros((4,), dtype=jnp.float64)  # BAD: float64
+    ids = state.astype(jnp.int64)  # BAD: int64
+    return acc, ids

@@ -1,0 +1,8 @@
+"""Known-bad: bare numpy ops on traced data inside jitted code."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def reduce_state(state):
+    return np.sum(state)  # BAD: host numpy on a tracer

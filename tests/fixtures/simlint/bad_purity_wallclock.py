@@ -1,0 +1,14 @@
+"""Known-bad: wall-clock and RNG reads inside jitted code."""
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def tick(state):
+    now = time.time()  # BAD: frozen at trace time
+    jitter = random.random()  # BAD: host RNG
+    noise = np.random.normal()  # BAD: host RNG
+    return state + now + jitter + noise

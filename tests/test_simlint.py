@@ -1,0 +1,248 @@
+"""simlint: the analyzer gate (tier-1).
+
+(a) the real package analyzes clean — zero unsuppressed findings — and the
+    CLI exits 0 on it; (b) each rule family is pinned against a known-bad
+    fixture the CLI must reject; (c) the suppression-pragma path is covered:
+    a reasonless pragma is itself a finding, an unused pragma is stale;
+    (d) the lockset pass provably parses scheduler_host.py's real
+    ``# guards:`` annotations, and the purity pass provably reaches the
+    engine's tick internals (so "clean" can never mean "checked nothing").
+
+No test here imports jax — simlint is pure ast/stdlib, so this file stays
+fast and runs on any machine.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+PKG_DIR = REPO / "multi_cluster_simulator_tpu"
+FIXTURES = Path(__file__).parent / "fixtures" / "simlint"
+
+sys.path.insert(0, str(REPO))  # tools/ is repo-rooted
+
+from tools.simlint import ALL_RULES, run  # noqa: E402
+from tools.simlint.callgraph import CallGraph  # noqa: E402
+from tools.simlint.lockset import parse_locks  # noqa: E402
+from tools.simlint.project import load_target  # noqa: E402
+
+
+def _cli(*targets: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tools.simlint", *targets],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# (a) the real package is clean
+# ---------------------------------------------------------------------------
+
+def test_package_has_zero_unsuppressed_findings():
+    findings = run(str(PKG_DIR))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exits_zero_on_package():
+    proc = _cli("multi_cluster_simulator_tpu")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# (b) every rule family pinned against a known-bad fixture
+# ---------------------------------------------------------------------------
+
+FIXTURE_RULES = [
+    ("bad_purity_branch.py", "purity-traced-branch"),
+    ("bad_purity_wallclock.py", "purity-wallclock"),
+    ("bad_purity_coerce.py", "purity-host-coerce"),
+    ("bad_purity_np.py", "purity-np-call"),
+    ("bad_purity_dtype.py", "purity-dtype64"),
+    ("bad_lockset.py", "lock-unguarded-access"),
+    ("bad_lockset.py", "lock-holds-violation"),
+    ("bad_det_set.py", "det-unordered-iter"),
+    ("bad_det_wallclock.py", "det-wallclock"),
+    ("bad_pragma.py", "pragma-no-reason"),
+    ("bad_pragma.py", "pragma-stale"),
+]
+
+
+@pytest.mark.parametrize("fixture,rule", FIXTURE_RULES)
+def test_fixture_raises_rule(fixture, rule):
+    findings = run(str(FIXTURES / fixture))
+    assert any(f.rule == rule for f in findings), (
+        f"{fixture} should raise {rule}; got "
+        + (", ".join(sorted({f.rule for f in findings})) or "nothing"))
+
+
+@pytest.mark.parametrize("fixture",
+                         sorted({f for f, _ in FIXTURE_RULES}))
+def test_cli_exits_nonzero_on_fixture(fixture):
+    proc = _cli(str(FIXTURES / fixture))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+def test_rules_are_known():
+    for _, rule in FIXTURE_RULES:
+        assert rule in ALL_RULES
+
+
+# ---------------------------------------------------------------------------
+# (c) the suppression-pragma path
+# ---------------------------------------------------------------------------
+
+def test_pragma_with_reason_suppresses(tmp_path):
+    f = tmp_path / "suppressed.py"
+    f.write_text(
+        "import time\n\n\n"
+        "def tick(state):\n"
+        "    t0 = time.time()  # simlint: ignore[det-wallclock] -- "
+        "bench-only path, never in replay\n"
+        "    return state, t0\n")
+    assert run(str(f)) == []
+
+
+def test_pragma_without_reason_is_a_finding(tmp_path):
+    f = tmp_path / "noreason.py"
+    f.write_text(
+        "import time\n\n\n"
+        "def tick(state):\n"
+        "    t0 = time.time()  # simlint: ignore[det-wallclock]\n"
+        "    return state, t0\n")
+    rules = {x.rule for x in run(str(f))}
+    assert rules == {"pragma-no-reason"}  # suppression works, audit fires
+
+
+def test_unused_pragma_is_stale(tmp_path):
+    f = tmp_path / "stale.py"
+    f.write_text(
+        "def tick(state):\n"
+        "    # simlint: ignore[det-wallclock] -- no longer needed\n"
+        "    return state\n")
+    rules = {x.rule for x in run(str(f))}
+    assert rules == {"pragma-stale"}
+
+
+def test_standalone_pragma_covers_next_code_line(tmp_path):
+    f = tmp_path / "standalone.py"
+    f.write_text(
+        "import time\n\n\n"
+        "def tick(state):\n"
+        "    # simlint: ignore[det-wallclock] -- a two-line justification\n"
+        "    # explaining exactly why this read is safe here\n"
+        "    t0 = time.time()\n"
+        "    return state, t0\n")
+    assert run(str(f)) == []
+
+
+def test_pragma_cannot_silence_the_pragma_audit(tmp_path):
+    f = tmp_path / "meta.py"
+    f.write_text(
+        "import time\n\n\n"
+        "def tick(state):\n"
+        "    t0 = time.time()  # simlint: ignore[det-wallclock, "
+        "pragma-no-reason]\n"
+        "    return state, t0\n")
+    assert "pragma-no-reason" in {x.rule for x in run(str(f))}
+
+
+# ---------------------------------------------------------------------------
+# (d) the passes provably engage with the real code
+# ---------------------------------------------------------------------------
+
+def _module(relname: str):
+    modules, _ = load_target(str(PKG_DIR))
+    for m in modules:
+        if m.relpath == relname:
+            return m
+    raise AssertionError(f"{relname} not loaded")
+
+
+def test_reentrant_rlock_nesting_is_not_flagged(tmp_path):
+    """Nested `with self._lock:` inside an outer `with self._lock:` (legal
+    RLock re-entry) must not release the outer hold on inner exit."""
+    f = tmp_path / "reentrant.py"
+    f.write_text(
+        "import threading\n\n\n"
+        "class Host:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()  # guards: state\n"
+        "        self.state = 0\n\n"
+        "    def step(self):\n"
+        "        with self._lock:\n"
+        "            with self._lock:\n"
+        "                self.state += 1\n"
+        "            self.state += 1  # outer lock still held here\n")
+    assert run(str(f)) == []
+
+
+def test_list_over_set_iteration_is_flagged(tmp_path):
+    """list(my_set) freezes the hash-dependent order — still flagged;
+    sorted(my_set) is the deterministic fix."""
+    f = tmp_path / "listset.py"
+    f.write_text(
+        "def drain(ids):\n"
+        "    pending = set(ids)\n"
+        "    for i in list(pending):\n"
+        "        pass\n"
+        "    for i in sorted(pending):\n"
+        "        pass\n")
+    findings = [x for x in run(str(f)) if x.rule == "det-unordered-iter"]
+    assert len(findings) == 1 and findings[0].line == 3
+
+
+def test_lockset_parses_scheduler_host_real_annotation():
+    locks = parse_locks(_module("services/scheduler_host.py"))
+    assert "SchedulerService" in locks
+    guards = locks["SchedulerService"].guards
+    assert set(guards["_slock"]) >= {"state", "_arr", "_arr_n", "_journal",
+                                     "_owner_urls", "_owner_idx"}
+    assert guards["_plock"] == ("_pending",)
+    owner = locks["SchedulerService"].owner
+    assert owner["state"] == "_slock" and owner["_pending"] == "_plock"
+
+
+def test_lockset_parses_telemetry_and_trader_annotations():
+    tel = parse_locks(_module("services/telemetry.py"))
+    assert set(tel["Tracer"].guards["_lock"]) == {"_batch", "_flusher",
+                                                  "_channel"}
+    assert "_counters" in tel["Meter"].guards["_lock"]
+    tr = parse_locks(_module("services/trader_host.py"))
+    assert set(tr["TraderService"].guards["_peer_lock"]) == {
+        "_peer_clients", "trades_won", "trades_sold"}
+
+
+def test_purity_reaches_the_tick_internals():
+    modules, _ = load_target(str(PKG_DIR))
+    graph = CallGraph(modules)
+    reached = {q for (_, q) in graph.reachable}
+    # the jit closure must cover the engine tick, the scheduling passes,
+    # the market round, and the ops kernels...
+    for name in ("Engine._tick", "_delay_local", "_fifo_local",
+                 "_wave_place", "trade_round", "_round", "first_fit",
+                 "push_many", "carve_plan"):
+        assert any(q == name or q.endswith("." + name) for q in reached), \
+            f"{name} not jit-reachable — the purity pass lost the tick path"
+    # ...and must NOT swallow the host-side stream bucketing (numpy code
+    # that legitimately branches on data)
+    assert not any(q.endswith("pack_arrivals_by_tick") for q in reached)
+
+
+def test_detects_injected_engine_regression(tmp_path):
+    """End-to-end: a realistic regression pasted into a copy of the real
+    engine module is caught — the analyzer is judged against the code it
+    exists to protect, not only against synthetic fixtures."""
+    src = (PKG_DIR / "core" / "engine.py").read_text()
+    bad = src.replace(
+        "    process = s.l0.count > 0\n",
+        "    process = s.l0.count > 0\n"
+        "    if s.wait_total > 0:\n"
+        "        process = process & True\n", 1)
+    assert bad != src, "anchor line moved; update this test"
+    f = tmp_path / "engine_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "purity-traced-branch" for x in run(str(f)))

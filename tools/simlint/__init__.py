@@ -1,0 +1,28 @@
+"""simlint — project-native static analysis for the TPU cluster simulator.
+
+Three rule families guard the two invariant classes the whole design rests
+on (see LINTING.md):
+
+- **tracer purity** (``purity-*``): code reachable from a ``jax.jit`` entry
+  point must be a pure trace — no host branches on traced values, no
+  wall-clock or RNG reads, no host coercions of device arrays, no bare
+  ``np.`` ops on traced data, no 64-bit dtype leaks into the int32-
+  disciplined engine.
+- **lock discipline** (``lock-*``): the service hosts reproduce the
+  reference's concurrent goroutines with hand-managed locks; every lock
+  declares what it guards (``# guards: a, b``) and every access to a
+  guarded attribute must sit inside ``with self.<lock>`` (or in a method
+  annotated ``# holds: <lock>`` whose callers are checked instead).
+- **tick determinism** (``det-*``): tick-path and market-round code promises
+  bit-identical replay (PARITY.md, MARKET.md) — unordered set iteration and
+  wall-clock reads are flagged.
+
+Suppression: ``# simlint: ignore[rule] -- reason``. A pragma without a
+reason is itself a finding (``pragma-no-reason``); a pragma that suppresses
+nothing is reported stale (``pragma-stale``).
+"""
+
+from tools.simlint.findings import Finding, Pragma
+from tools.simlint.runner import ALL_RULES, run
+
+__all__ = ["Finding", "Pragma", "run", "ALL_RULES"]

@@ -1,0 +1,62 @@
+"""CLI: ``python -m tools.simlint <target> [...]``.
+
+Exit status 0 when every target is clean, 1 when any unsuppressed finding
+remains, 2 on usage errors. Output is one ``file:line rule message`` per
+finding — greppable, CI-friendly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.simlint.runner import ALL_RULES, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.simlint",
+        description="Project-native static analysis: tracer purity, lock "
+                    "discipline, tick determinism (see LINTING.md).")
+    ap.add_argument("targets", nargs="*",
+                    help="package directory, importable package name, or "
+                         ".py files (files get every rule family)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all; "
+                         "disables the stale-pragma audit)")
+    ap.add_argument("--no-stale", action="store_true",
+                    help="skip the stale-pragma audit")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print("\n".join(ALL_RULES))
+        return 0
+    if not args.targets:
+        ap.error("the following arguments are required: targets")
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in ALL_RULES]
+        if unknown:
+            print(f"unknown rules: {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    total = 0
+    for target in args.targets:
+        try:
+            found = run(target, rules=rules, stale_check=not args.no_stale)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        for f in found:
+            print(f.render())
+        total += len(found)
+    print(f"simlint: {total} finding(s)"
+          + ("" if total else " — clean"), file=sys.stderr)
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

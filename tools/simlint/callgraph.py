@@ -1,0 +1,306 @@
+"""jit entry points + call-graph reachability over the parsed package.
+
+Entry points are functions wrapped by ``jax.jit`` — as a decorator
+(``@jax.jit``, ``@functools.partial(jax.jit, ...)``) or at a call site
+(``jax.jit(self.engine.tick_io)``, ``jax.jit(mapped)`` where ``mapped`` is a
+local built from ``jax.shard_map(body, ...)``). From there reachability
+follows every resolvable reference: direct calls, module-alias calls
+(``Q.push_many``), ``self`` methods, higher-order references passed to
+``jax.vmap``/``lax.scan``/``functools.partial``, and locals assigned from
+conditional expressions. Unresolvable attribute calls fall back to a
+package-wide name match — deliberate over-approximation: purity checking a
+function that is not actually jitted is noise at worst, while missing a
+jitted one is a hole.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from tools.simlint.project import Module
+
+_JIT_NAMES = ("jax.jit", "jit")
+_WRAP_SUFFIXES = (".jit", ".shard_map")
+
+# attribute names too generic for the package-wide name fallback — they are
+# overwhelmingly stdlib/array methods (x.at[i].add, dict.get, str.join, ...)
+# and would drag unrelated modules into the reachable set
+_FALLBACK_BLACKLIST = frozenset({
+    "add", "get", "set", "append", "extend", "items", "keys", "values",
+    "join", "start", "stop", "close", "copy", "update", "pop", "remove",
+    "sort", "split", "strip", "encode", "decode", "read", "write", "wait",
+    "submit", "result", "put", "send", "flush", "clear", "index", "count",
+})
+
+
+def dotted_name(expr) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = dotted_name(expr.value)
+        return None if base is None else f"{base}.{expr.attr}"
+    return None
+
+
+def _is_jit_ref(expr) -> bool:
+    d = dotted_name(expr)
+    return d is not None and (d in _JIT_NAMES or d.endswith(".jit"))
+
+
+def _is_wrapper_call(call: ast.Call) -> bool:
+    """``jax.jit(...)`` / ``jax.shard_map(...)`` call sites."""
+    d = dotted_name(call.func)
+    if d is None:
+        return False
+    return (d in _JIT_NAMES or d == "shard_map"
+            or any(d.endswith(s) for s in _WRAP_SUFFIXES))
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    key: tuple  # (module_name, qualname)
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    module: Module
+    class_name: Optional[str]  # innermost enclosing class
+    parent: Optional[tuple]  # enclosing function key, if nested
+
+
+class CallGraph:
+    def __init__(self, modules: list[Module]):
+        self.modules = {m.name: m for m in modules}
+        self.functions: dict[tuple, FuncInfo] = {}
+        self.by_name: dict[str, set] = {}  # last-component -> keys
+        # (module, class) -> {attr: set(keys)} from ``self.attr = <expr>``
+        self.class_attr_refs: dict[tuple, dict] = {}
+        # function key -> {local name: [RHS exprs]} (built lazily, once)
+        self._assign_index: dict[tuple, dict] = {}
+        self._local_memo: dict[tuple, frozenset] = {}
+        for m in modules:
+            self._index_module(m)
+        # second pass: ``self.attr = <expr>`` references need the full
+        # function index (modules may reference later-indexed modules)
+        for m in modules:
+            self._index_class_attrs(m)
+        self.entries = self._find_entries()
+        self.reachable = self._closure(self.entries)
+
+    # -- indexing ----------------------------------------------------------
+    def _index_module(self, mod: Module) -> None:
+        def visit(node, class_name, func_stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name, func_stack)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qual = ".".join([f for f in func_stack] + [child.name])
+                    if class_name and not func_stack:
+                        qual = f"{class_name}.{child.name}"
+                    elif class_name:
+                        qual = f"{class_name}." + qual
+                    key = (mod.name, qual)
+                    parent = None
+                    if func_stack:
+                        pq = ".".join(func_stack)
+                        if class_name:
+                            pq = f"{class_name}.{pq}"
+                        parent = (mod.name, pq)
+                    self.functions[key] = FuncInfo(
+                        key=key, node=child, module=mod,
+                        class_name=class_name, parent=parent)
+                    self.by_name.setdefault(child.name, set()).add(key)
+                    visit(child, class_name, func_stack + [child.name])
+                else:
+                    visit(child, class_name, func_stack)
+
+        visit(mod.tree, None, [])
+
+    def _index_class_attrs(self, mod: Module) -> None:
+        # self.attr = <expr> references, per class
+        for cls in [n for n in ast.walk(mod.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            refs: dict[str, set] = {}
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        keys = self._refs_in_expr(node.value, mod, cls.name,
+                                                  [])
+                        if keys:
+                            refs.setdefault(tgt.attr, set()).update(keys)
+            if refs:
+                self.class_attr_refs[(mod.name, cls.name)] = refs
+
+    # -- reference resolution ---------------------------------------------
+    def _resolve(self, expr, mod: Module, class_name, func_chain,
+                 depth: int = 0) -> set:
+        """Function keys a Name/Attribute expression may refer to."""
+        if depth > 3:
+            return set()
+        if isinstance(expr, ast.Name):
+            # nested def in an enclosing function, innermost first
+            for i in range(len(func_chain), 0, -1):
+                qual = ".".join(func_chain[:i] + [expr.id])
+                if class_name:
+                    qual = f"{class_name}.{qual}"
+                if (mod.name, qual) in self.functions:
+                    return {(mod.name, qual)}
+            if (mod.name, expr.id) in self.functions:
+                return {(mod.name, expr.id)}
+            if expr.id in mod.from_imports:
+                src, orig = mod.from_imports[expr.id]
+                if (src, orig) in self.functions:
+                    return {(src, orig)}
+                return set()
+            # a local assigned from function references?
+            return self._resolve_local(expr.id, mod, class_name, func_chain,
+                                       depth)
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and class_name:
+                    key = (mod.name, f"{class_name}.{expr.attr}")
+                    if key in self.functions:
+                        return {key}
+                    refs = self.class_attr_refs.get((mod.name, class_name),
+                                                    {})
+                    if expr.attr in refs:
+                        return set(refs[expr.attr])
+                    return self._fallback(expr.attr)
+                if base.id in mod.module_aliases:
+                    target = mod.module_aliases[base.id]
+                    if target in self.modules:
+                        key = (target, expr.attr)
+                        return {key} if key in self.functions else set()
+                    return set()  # external module (np/jax/...): no edge
+                if base.id in mod.from_imports:
+                    src, orig = mod.from_imports[base.id]
+                    full = f"{src}.{orig}"
+                    if full in self.modules:
+                        key = (full, expr.attr)
+                        return {key} if key in self.functions else set()
+            # unresolvable base (locals, chained attributes): name fallback
+            return self._fallback(expr.attr)
+        return set()
+
+    def _fallback(self, name: str) -> set:
+        if name in _FALLBACK_BLACKLIST:
+            return set()
+        return self.by_name.get(name, set())
+
+    def _assignments_of(self, key) -> dict:
+        cached = self._assign_index.get(key)
+        if cached is not None:
+            return cached
+        index: dict[str, list] = {}
+        info = self.functions.get(key)
+        if info is not None:
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        index.setdefault(t.id, []).append(node.value)
+        self._assign_index[key] = index
+        return index
+
+    def _resolve_local(self, name, mod, class_name, func_chain, depth) -> set:
+        """Resolve a local variable via its assignments' RHS references."""
+        memo_key = (mod.name, class_name, tuple(func_chain), name)
+        if memo_key in self._local_memo:
+            return set(self._local_memo[memo_key])
+        self._local_memo[memo_key] = frozenset()  # cycle guard
+        out: set = set()
+        for i in range(len(func_chain), 0, -1):
+            qual = ".".join(func_chain[:i])
+            if class_name:
+                qual = f"{class_name}.{qual}"
+            for value in self._assignments_of((mod.name, qual)).get(name, ()):
+                out |= self._refs_in_expr(value, mod, class_name,
+                                          func_chain, depth + 1)
+        self._local_memo[memo_key] = frozenset(out)
+        return out
+
+    def _refs_in_expr(self, expr, mod, class_name, func_chain,
+                      depth: int = 0) -> set:
+        out: set = set()
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                out |= self._resolve(node, mod, class_name, func_chain, depth)
+        return out
+
+    # -- entries and closure ----------------------------------------------
+    def _find_entries(self) -> set:
+        entries: set = set()
+        for key, info in self.functions.items():
+            for dec in getattr(info.node, "decorator_list", []):
+                if _is_jit_ref(dec):
+                    entries.add(key)
+                elif (isinstance(dec, ast.Call)
+                      and dotted_name(dec.func) is not None
+                      and dotted_name(dec.func).endswith("partial")
+                      and dec.args and _is_jit_ref(dec.args[0])):
+                    entries.add(key)
+        for mod in self.modules.values():
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and _is_wrapper_call(node)):
+                    continue
+                cls, chain = self._context_of(mod, node)
+                for arg in node.args:
+                    entries |= self._resolve(arg, mod, cls, chain) \
+                        if isinstance(arg, (ast.Name, ast.Attribute)) \
+                        else self._refs_in_expr(arg, mod, cls, chain)
+        return entries
+
+    def _context_of(self, mod: Module, target) -> tuple:
+        """(class_name, func_chain) lexically enclosing ``target``."""
+        result = (None, [])
+
+        def visit(node, class_name, chain):
+            nonlocal result
+            for child in ast.iter_child_nodes(node):
+                if child is target:
+                    result = (class_name, list(chain))
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name, chain)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    visit(child, class_name, chain + [child.name])
+                else:
+                    visit(child, class_name, chain)
+
+        visit(mod.tree, None, [])
+        return result
+
+    def _edges_of(self, key) -> set:
+        info = self.functions[key]
+        mod = info.module
+        chain = info.key[1].split(".")
+        if info.class_name and chain[0] == info.class_name:
+            chain = chain[1:]
+        out: set = set()
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            out |= self._resolve(node.func, mod, info.class_name, chain) \
+                if isinstance(node.func, (ast.Name, ast.Attribute)) else set()
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    out |= self._resolve(arg, mod, info.class_name, chain)
+        return out
+
+    def _closure(self, entries: set) -> set:
+        seen = set()
+        frontier = list(entries)
+        while frontier:
+            key = frontier.pop()
+            if key in seen or key not in self.functions:
+                continue
+            seen.add(key)
+            frontier.extend(self._edges_of(key) - seen)
+        return seen

@@ -1,0 +1,125 @@
+"""Determinism pass: tick-path and market-round code must replay
+bit-identically (PARITY.md, MARKET.md), with or without jit.
+
+- ``det-unordered-iter`` — iteration over a ``set``/``frozenset`` (literal,
+  constructor, comprehension, set-algebra result, or a local assigned from
+  one) and over unordered filesystem listings (``os.listdir``/``os.scandir``
+  /``glob.glob``/``.iterdir()``) outside a ``sorted(...)`` wrapper. Set
+  iteration order depends on insertion history and hash seeds; in traced
+  code it bakes a different program per run. Dict iteration is *not*
+  flagged: CPython dicts are insertion-ordered, which is deterministic.
+- ``det-wallclock`` — wall-clock/RNG reads (``time.time``, ``random.*``,
+  ``np.random.*``) anywhere in tick-path files, jitted or not: replay of
+  the same trace must produce the same states.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint.callgraph import dotted_name
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+_SET_ALGEBRA = ("union", "intersection", "difference",
+                "symmetric_difference")
+_FS_LISTING = ("os.listdir", "os.scandir", "glob.glob", "glob.iglob")
+_WALLCLOCK = (
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.strftime",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+)
+
+
+def _is_set_expr(expr, set_locals: set) -> bool:
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call):
+        d = dotted_name(expr.func) or ""
+        if d in ("set", "frozenset"):
+            return True
+        # list(my_set)/tuple(my_set) freeze the hash-dependent order —
+        # still nondeterministic; sorted(my_set) is the fix
+        if d in ("list", "tuple") and expr.args \
+                and _is_set_expr(expr.args[0], set_locals):
+            return True
+        if (isinstance(expr.func, ast.Attribute)
+                and expr.func.attr in _SET_ALGEBRA):
+            return True
+    if isinstance(expr, ast.Name):
+        return expr.id in set_locals
+    if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        return (_is_set_expr(expr.left, set_locals)
+                or _is_set_expr(expr.right, set_locals))
+    return False
+
+
+def _is_unsorted_fs_listing(expr) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    d = dotted_name(expr.func) or ""
+    return d in _FS_LISTING or (isinstance(expr.func, ast.Attribute)
+                                and expr.func.attr == "iterdir")
+
+
+def check_module(mod: Module) -> list[Finding]:
+    findings: set[tuple] = set()
+    random_aliases = frozenset(
+        {a for a, m in mod.module_aliases.items() if m == "random"} | {
+            a for a, (src, orig) in mod.from_imports.items()
+            if src == "numpy" and orig == "random"})
+    np_aliases = frozenset(
+        {a for a, m in mod.module_aliases.items() if m == "numpy"})
+
+    # locals assigned set-valued expressions, per module (name-level only)
+    set_locals: set = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and _is_set_expr(node.value, set()):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    set_locals.add(tgt.id)
+        if isinstance(node, ast.AnnAssign) and node.value is not None \
+                and _is_set_expr(node.value, set()):
+            if isinstance(node.target, ast.Name):
+                set_locals.add(node.target.id)
+
+    def iter_exprs():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                yield node.iter, node.lineno
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for gen in node.generators:
+                    yield gen.iter, node.lineno
+
+    # ``for x in sorted(s)`` needs no special case: the iter expression is
+    # the sorted() Call, which _is_set_expr does not treat as a set
+    for expr, lineno in iter_exprs():
+        if _is_set_expr(expr, set_locals):
+            findings.add((lineno, "det-unordered-iter",
+                          "iteration over a set in tick-path code; "
+                          "iterate sorted(...) or use an ordered "
+                          "container — set order is hash/insertion "
+                          "dependent and breaks bit-identical replay"))
+        elif _is_unsorted_fs_listing(expr):
+            findings.add((lineno, "det-unordered-iter",
+                          "unsorted filesystem listing in tick-path "
+                          "code; wrap in sorted(...)"))
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func) or ""
+        root = d.split(".")[0]
+        if (d in _WALLCLOCK or root in random_aliases
+                or (root in np_aliases and ".random." in f".{d}.")):
+            findings.add((node.lineno, "det-wallclock",
+                          f"wall-clock/RNG call `{d}` in tick-path code; "
+                          "the replay contract is bit-identical states "
+                          "from identical inputs — derive times from the "
+                          "virtual clock and randomness from seeded keys"))
+
+    return [Finding(mod.path, line, rule, msg)
+            for (line, rule, msg) in sorted(findings)]

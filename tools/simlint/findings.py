@@ -1,0 +1,107 @@
+"""Finding + suppression-pragma model shared by every pass."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+# ``# simlint: ignore[rule-a, rule-b] -- reason`` (reason mandatory; its
+# absence is the pragma-no-reason finding, not a parse failure)
+_PRAGMA_RE = re.compile(
+    r"#\s*simlint:\s*ignore\[([A-Za-z0-9_\-, ]+)\]\s*(?:--\s*(\S.*))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One ``file:line rule message`` diagnostic."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class Pragma:
+    """One parsed suppression comment."""
+
+    path: str
+    line: int  # line the pragma comment sits on
+    rules: tuple[str, ...]
+    reason: Optional[str]
+    # comment-only pragma: also covers the next code line (blank and
+    # comment-continuation lines in between are skipped)
+    target_line: Optional[int]
+    used: bool = False
+
+    def covers(self, line: int) -> bool:
+        return line == self.line or line == self.target_line
+
+
+def parse_pragmas(path: str, source: str) -> list[Pragma]:
+    pragmas = []
+    lines = source.splitlines()
+    for lineno, text in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(text)
+        if m is None:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        target = None
+        if text[: m.start()].strip() == "":  # comment-only pragma line
+            for nxt in range(lineno, len(lines)):
+                stripped = lines[nxt].strip()
+                if stripped and not stripped.startswith("#"):
+                    target = nxt + 1
+                    break
+        pragmas.append(Pragma(path=path, line=lineno, rules=rules,
+                              reason=m.group(2), target_line=target))
+    return pragmas
+
+
+def apply_pragmas(findings: list[Finding],
+                  pragmas: list[Pragma]) -> list[Finding]:
+    """Drop findings covered by a pragma naming their rule; mark the pragma
+    used. Pragma-misuse findings (``pragma-*``) are never suppressible —
+    a pragma must not be able to silence the audit of pragmas."""
+    kept = []
+    for f in findings:
+        if f.rule.startswith("pragma-"):
+            kept.append(f)
+            continue
+        hit = None
+        for p in pragmas:
+            if p.path == f.path and p.covers(f.line) and f.rule in p.rules:
+                hit = p
+                break
+        if hit is None:
+            kept.append(f)
+        else:
+            hit.used = True
+    return kept
+
+
+def pragma_findings(pragmas: list[Pragma], checked_rules) -> list[Finding]:
+    """The pragma audit: missing reasons and stale (unused) pragmas.
+
+    ``checked_rules``: rules that actually ran over the pragma's file — a
+    pragma naming a rule that never ran there is dead weight and reported
+    stale as well."""
+    out = []
+    for p in pragmas:
+        if not p.reason:
+            out.append(Finding(
+                p.path, p.line, "pragma-no-reason",
+                "suppression pragma without a justification; write "
+                "'# simlint: ignore[rule] -- why this is safe'"))
+        if not p.used:
+            ran = ", ".join(r for r in p.rules if r in checked_rules)
+            out.append(Finding(
+                p.path, p.line, "pragma-stale",
+                f"pragma ignore[{', '.join(p.rules)}] suppressed nothing"
+                + ("" if ran else " (rule never runs on this file)")
+                + "; delete it"))
+    return out

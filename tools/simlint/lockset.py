@@ -1,0 +1,240 @@
+"""Lockset pass: the ``# guards:`` convention over the service hosts.
+
+A lock declares its protected attributes where it is created::
+
+    self._slock = threading.RLock()  # guards: state, _arr, _arr_n
+
+Every ``self.<attr>`` access (read or write) to a guarded attribute must
+then sit lexically inside ``with self.<lock>:`` — from any method, because
+the hosts run HTTP handler threads, tick threads, flusher threads and gRPC
+streams against the same object. Two escape hatches keep the rule honest
+instead of noisy:
+
+- ``__init__`` (and helpers called *only* from ``__init__``, transitively)
+  run before any thread exists and are exempt;
+- a method that documents a caller-held lock with ``# holds: _slock`` on
+  its ``def`` line is analyzed as if it held the lock — and every intra-
+  class *call site* of that method is checked for actually holding it
+  (``lock-holds-violation``).
+
+Closures and nested functions start with an empty lockset: they usually run
+later, on another thread (Thread targets, journal replays), so the ``with``
+they were defined under proves nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from tools.simlint.callgraph import dotted_name
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+_GUARDS_RE = re.compile(r"#\s*guards:\s*([A-Za-z0-9_,\s]+)")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([A-Za-z0-9_,\s]+)")
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "Lock", "RLock")
+
+
+@dataclasses.dataclass
+class ClassLocks:
+    class_name: str
+    # lock attr -> guarded attr names
+    guards: dict[str, tuple[str, ...]] = dataclasses.field(
+        default_factory=dict)
+    # guarded attr -> lock attr
+    owner: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _source_line(mod: Module, lineno: int) -> str:
+    return mod.line(lineno)
+
+
+def parse_class_locks(mod: Module, cls: ast.ClassDef) -> ClassLocks:
+    out = ClassLocks(class_name=cls.name)
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call)
+                and (dotted_name(node.value.func) or "") in _LOCK_CTORS):
+            continue
+        for tgt in node.targets:
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            m = _GUARDS_RE.search(_source_line(mod, node.lineno))
+            if m is None:
+                continue  # unannotated lock: not tracked (see LINTING.md)
+            attrs = tuple(a.strip() for a in m.group(1).split(",")
+                          if a.strip())
+            out.guards[tgt.attr] = attrs
+            for a in attrs:
+                out.owner[a] = tgt.attr
+    return out
+
+
+def parse_locks(mod: Module) -> dict[str, ClassLocks]:
+    """Public: class name -> parsed lock map (used by tests to prove the
+    real annotations parse, not just fixtures)."""
+    return {cls.name: parse_class_locks(mod, cls)
+            for cls in ast.walk(mod.tree) if isinstance(cls, ast.ClassDef)
+            if parse_class_locks(mod, cls).guards}
+
+
+def _holds_of(mod: Module, fn: ast.FunctionDef) -> frozenset:
+    first = fn.body[0].lineno if fn.body else fn.lineno
+    held = set()
+    for lineno in range(fn.lineno, first + 1):
+        m = _HOLDS_RE.search(_source_line(mod, lineno))
+        if m:
+            held |= {a.strip() for a in m.group(1).split(",") if a.strip()}
+    return frozenset(held)
+
+
+def _init_only_methods(cls: ast.ClassDef) -> frozenset:
+    """Methods reachable exclusively from ``__init__``: exempt (no thread
+    exists yet). A method referenced outside a call position (a Thread
+    target, a route handler) escapes and is never exempt."""
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    callers: dict[str, set] = {name: set() for name in methods}
+    escapes: set = set()
+    for name, fn in methods.items():
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr in methods):
+                continue
+            parent_is_call = any(
+                isinstance(p, ast.Call) and p.func is node
+                for p in ast.walk(fn))
+            if parent_is_call:
+                callers[node.attr].add(name)
+            else:
+                escapes.add(node.attr)
+    exempt = {"__init__"}
+    changed = True
+    while changed:
+        changed = False
+        for name, c in callers.items():
+            if (name not in exempt and name not in escapes and c
+                    and c <= exempt):
+                exempt.add(name)
+                changed = True
+    return frozenset(exempt)
+
+
+class _MethodChecker(ast.NodeVisitor):
+    def __init__(self, mod: Module, locks: ClassLocks,
+                 holds_map: dict[str, frozenset], method: ast.FunctionDef,
+                 initial_held: frozenset, findings: list):
+        self.mod = mod
+        self.locks = locks
+        self.holds_map = holds_map
+        self.method = method
+        self.held: set = set(initial_held)
+        self.findings = findings
+
+    def _lock_of_withitem(self, item: ast.withitem):
+        expr = item.context_expr
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in self.locks.guards):
+            return expr.attr
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        # a lock already held (RLock re-entry) must stay held on exit of
+        # the inner block — only newly-taken locks are released below
+        taken = [lk for item in node.items
+                 if (lk := self._lock_of_withitem(item)) is not None
+                 and lk not in self.held]
+        for item in node.items:  # the lock exprs themselves are fine
+            self.visit(item.context_expr)
+        self.held.update(taken)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lk in taken:
+            self.held.discard(lk)
+
+    visit_AsyncWith = visit_With
+
+    def _enter_closure(self, node) -> None:
+        """Nested def/lambda: runs later, usually on another thread —
+        restart with only its own ``# holds:`` annotation."""
+        saved = self.held
+        self.held = set(_holds_of(self.mod, node)) \
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) else set()
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.held = saved
+
+    def visit_FunctionDef(self, node) -> None:
+        if node is self.method:
+            for child in ast.iter_child_nodes(node):
+                self.visit(child)
+        else:
+            self._enter_closure(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_closure(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and node.attr in self.locks.owner):
+            lock = self.locks.owner[node.attr]
+            if lock not in self.held:
+                kind = ("write" if isinstance(node.ctx,
+                                              (ast.Store, ast.Del))
+                        else "read")
+                self.findings.append(Finding(
+                    self.mod.path, node.lineno, "lock-unguarded-access",
+                    f"{kind} of self.{node.attr} outside `with "
+                    f"self.{lock}` (declared '# guards:' on {lock}) in "
+                    f"{self.locks.class_name}.{self.method.name}"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self" and fn.attr in self.holds_map):
+            missing = self.holds_map[fn.attr] - frozenset(self.held)
+            missing &= frozenset(self.locks.guards)  # only declared locks
+            if missing:
+                self.findings.append(Finding(
+                    self.mod.path, node.lineno, "lock-holds-violation",
+                    f"call to self.{fn.attr}() (annotated '# holds: "
+                    f"{', '.join(sorted(self.holds_map[fn.attr]))}') "
+                    f"without holding {', '.join(sorted(missing))} in "
+                    f"{self.locks.class_name}.{self.method.name}"))
+        self.generic_visit(node)
+
+
+def check_module(mod: Module) -> list[Finding]:
+    findings: list[Finding] = []
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = parse_class_locks(mod, cls)
+        if not locks.guards:
+            continue
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        holds_map = {m.name: _holds_of(mod, m) for m in methods
+                     if _holds_of(mod, m)}
+        exempt = _init_only_methods(cls)
+        for m in methods:
+            if m.name in exempt:
+                continue
+            checker = _MethodChecker(mod, locks, holds_map, m,
+                                     holds_map.get(m.name, frozenset()),
+                                     findings)
+            checker.visit(m)
+    return findings

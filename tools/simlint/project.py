@@ -1,0 +1,103 @@
+"""Target loading: parse a package (or explicit files) into Module records.
+
+No target code is ever imported — everything is ``ast`` + source text, so
+the analyzer runs identically with or without jax/grpc installed and can
+never execute the code it judges.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Optional
+
+# generated protobuf stubs are not ours to lint
+_EXCLUDED_PARTS = ("proto",)
+
+
+@dataclasses.dataclass
+class Module:
+    name: str  # dotted module name ("pkg.core.engine")
+    path: str  # as reported in findings
+    relpath: str  # package-relative ("core/engine.py"); "" scope for files
+    source: str
+    tree: ast.Module
+    # import alias -> dotted module name ("np" -> "numpy",
+    # "Q" -> "pkg.ops.queues"); from-import alias -> (module, name)
+    module_aliases: dict = dataclasses.field(default_factory=dict)
+    from_imports: dict = dataclasses.field(default_factory=dict)
+
+    def line(self, lineno: int) -> str:
+        lines = self.source.splitlines()
+        return lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+
+
+def _collect_imports(mod: Module) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.module_aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            src = node.module
+            if node.level:  # relative import: resolve against this module
+                base = mod.name.split(".")[: -node.level]
+                src = ".".join(base + [node.module])
+            for a in node.names:
+                mod.from_imports[a.asname or a.name] = (src, a.name)
+
+
+def _load_file(path: str, name: str, relpath: str) -> Optional[Module]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    mod = Module(name=name, path=path, relpath=relpath, source=source,
+                 tree=tree)
+    _collect_imports(mod)
+    return mod
+
+
+def load_target(target: str) -> tuple[list[Module], Optional[str]]:
+    """Load ``target`` — a package directory, an importable package name
+    found on the current working directory, or a single ``.py`` file.
+    Returns (modules, package_root_dir); package_root_dir is None for
+    explicit single files (every rule family then applies to them)."""
+    if target.endswith(".py") and os.path.isfile(target):
+        name = os.path.splitext(os.path.basename(target))[0]
+        mod = _load_file(target, name, relpath="")
+        return ([mod] if mod else []), None
+    root = target if os.path.isdir(target) else target.replace(".", os.sep)
+    if not os.path.isdir(root):
+        raise FileNotFoundError(
+            f"simlint target {target!r} is neither a package directory, an "
+            "importable package in the cwd, nor a .py file")
+    pkg = os.path.basename(os.path.normpath(root))
+    modules = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in _EXCLUDED_PARTS
+                             and not d.startswith((".", "__")))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            dotted = pkg + "." + rel[:-3].replace(os.sep, ".")
+            if dotted.endswith(".__init__"):
+                dotted = dotted[: -len(".__init__")]
+            mod = _load_file(path, dotted, relpath=rel.replace(os.sep, "/"))
+            if mod is not None:
+                modules.append(mod)
+    return modules, root
+
+
+def in_scope(mod: Module, scope_dirs: tuple[str, ...],
+             extra_files: tuple[str, ...] = ()) -> bool:
+    """Package-relative scoping; explicit single files match every scope."""
+    if mod.relpath == "":
+        return True
+    top = mod.relpath.split("/", 1)[0]
+    return top in scope_dirs or mod.relpath in extra_files

@@ -1,0 +1,238 @@
+"""Tracer-purity pass: rules for code reachable from a ``jax.jit`` entry.
+
+Taint model: a value is *traced* when it derives from a function parameter
+that is not statically known (configs, ``self``, and Python-scalar-annotated
+parameters are static — jit callers pass those as static arguments or close
+over them) or from any ``jnp.``/``jax.`` call result. Chains through
+``.shape``/``.dtype``/``.ndim``/``.size``/``.capacity``, ``len()`` and
+``isinstance()`` are static: those are trace-time Python values.
+
+Rules:
+- ``purity-traced-branch`` — ``if``/``while``/``assert`` on a traced value:
+  inside jit this raises a ConcretizationTypeError at best and silently
+  bakes one trace-time branch into the compiled program at worst.
+- ``purity-wallclock``    — ``time.*``/``random.*``/``np.random.*``/
+  ``secrets.*``/``datetime.now`` calls: evaluated once at trace time, the
+  compiled tick replays a frozen value forever.
+- ``purity-host-coerce``  — ``int()``/``float()``/``bool()``/``.item()``/
+  ``.tolist()`` on traced values: forces a device sync inside the trace.
+- ``purity-np-call``      — bare ``np.`` ops on traced arguments where
+  ``jnp`` is required (host numpy silently materializes the tracer).
+- ``purity-dtype64``      — ``float64``/``int64`` dtype references in the
+  int32-disciplined engine (core/engine.py keeps all state int32; a 64-bit
+  leaf changes every downstream dtype under x64 and truncates without it).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint.callgraph import CallGraph, dotted_name
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+STATIC_PARAM_NAMES = frozenset({
+    "self", "cls", "cfg", "config", "mcfg", "tcfg", "wcfg", "ex", "mesh",
+    "axis", "mode", "place",
+})
+STATIC_ANNOTATIONS = frozenset({
+    "int", "bool", "str", "float", "SimConfig", "TraderConfig",
+    "WorkloadConfig", "PolicyKind", "MatchKind", "Mesh",
+})
+# attribute accesses that return trace-time Python values even on tracers
+STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "capacity"})
+_JAX_ROOTS = frozenset({"jnp", "jax", "lax"})
+_WALLCLOCK = (
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.strftime",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+)
+_DTYPE64_ATTRS = ("np.float64", "np.int64", "numpy.float64", "numpy.int64",
+                  "jnp.float64", "jnp.int64")
+
+
+def _annotation_name(ann) -> str:
+    if ann is None:
+        return ""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value
+    d = dotted_name(ann)
+    return (d or "").split(".")[-1]
+
+
+def _static_param(arg: ast.arg) -> bool:
+    return (arg.arg in STATIC_PARAM_NAMES
+            or _annotation_name(arg.annotation) in STATIC_ANNOTATIONS)
+
+
+class _Tainter:
+    """Optimistic forward taint over one function body (nested defs
+    included — they trace as part of the same jit program)."""
+
+    def __init__(self, fn: ast.AST):
+        self.env: dict[str, bool] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                a = node.args
+                for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                            + ([a.vararg] if a.vararg else [])
+                            + ([a.kwarg] if a.kwarg else [])):
+                    self.env[arg.arg] = not _static_param(arg)
+        # one forward pass over assignments in source order
+        for node in sorted(
+                (n for n in ast.walk(fn)
+                 if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                   ast.For, ast.NamedExpr))),
+                key=lambda n: (n.lineno, n.col_offset)):
+            if isinstance(node, ast.For):
+                t = self.taint(node.iter)
+                for tgt in ast.walk(node.target):
+                    if isinstance(tgt, ast.Name):
+                        self.env[tgt.id] = self.env.get(tgt.id, False) or t
+                continue
+            value = node.value
+            if value is None:
+                continue
+            t = self.taint(value)
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                for leaf in ast.walk(tgt):
+                    if isinstance(leaf, ast.Name):
+                        prev = self.env.get(leaf.id, False)
+                        aug = isinstance(node, ast.AugAssign)
+                        self.env[leaf.id] = t or (prev and aug)
+
+    def taint(self, expr) -> bool:
+        if expr is None or isinstance(expr, ast.Constant):
+            return False
+        if isinstance(expr, ast.Name):
+            return self.env.get(expr.id, False)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in STATIC_ATTRS:
+                return False
+            return self.taint(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return self.taint(expr.value)
+        if isinstance(expr, ast.Call):
+            d = dotted_name(expr.func) or ""
+            root = d.split(".")[0]
+            if root in _JAX_ROOTS:
+                return True
+            if d in ("len", "isinstance", "issubclass", "type", "hasattr"):
+                return False  # trace-time Python values even on tracers
+            args = list(expr.args) + [k.value for k in expr.keywords]
+            if any(self.taint(a) for a in args):
+                return True
+            # a method on a traced object returns traced data (.astype, ...)
+            return (isinstance(expr.func, ast.Attribute)
+                    and self.taint(expr.func.value))
+        if isinstance(expr, ast.Lambda):
+            return False
+        return any(self.taint(c) for c in ast.iter_child_nodes(expr)
+                   if isinstance(c, ast.expr))
+
+
+def _np_alias_set(mod: Module) -> frozenset:
+    out = {a for a, m in mod.module_aliases.items() if m == "numpy"}
+    return frozenset(out or {"np"})
+
+
+def _call_dotted(call: ast.Call) -> str:
+    return dotted_name(call.func) or ""
+
+
+def check_module(mod: Module, graph: CallGraph) -> list[Finding]:
+    findings: set[tuple] = set()
+    np_aliases = _np_alias_set(mod)
+    random_aliases = frozenset(
+        {a for a, m in mod.module_aliases.items() if m == "random"} | {
+            a for a, (src, orig) in mod.from_imports.items()
+            if src == "numpy" and orig == "random"})
+
+    for key, info in graph.functions.items():
+        if info.module is not mod or key not in graph.reachable:
+            continue
+        # nested defs are walked as part of their reachable parent
+        if info.parent is not None and info.parent in graph.reachable:
+            continue
+        tainter = _Tainter(info.node)
+        for node in ast.walk(info.node):
+            _check_node(node, tainter, np_aliases, random_aliases,
+                        findings)
+    return [Finding(mod.path, line, rule, msg)
+            for (line, rule, msg) in sorted(findings)]
+
+
+def _check_node(node, tainter, np_aliases, random_aliases,
+                findings: set) -> None:
+    if isinstance(node, (ast.If, ast.While)):
+        if tainter.taint(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            findings.add((node.lineno, "purity-traced-branch",
+                          f"Python `{kind}` on a traced value inside jitted "
+                          "code; use jnp.where/lax.cond or hoist the value "
+                          "to a static argument"))
+    elif isinstance(node, ast.Assert):
+        if tainter.taint(node.test):
+            findings.add((node.lineno, "purity-traced-branch",
+                          "`assert` on a traced value inside jitted code; "
+                          "use checkify or assert on static shape/dtype "
+                          "facts only"))
+    if not isinstance(node, ast.Call):
+        return
+    d = _call_dotted(node)
+    root = d.split(".")[0]
+    args = list(node.args) + [k.value for k in node.keywords]
+
+    if (d in _WALLCLOCK or root in random_aliases or root == "secrets"
+            or (root in np_aliases and ".random." in f".{d}.")
+            or d.endswith("random.default_rng")):
+        findings.add((node.lineno, "purity-wallclock",
+                      f"host wall-clock/RNG call `{d}` inside jitted code "
+                      "is frozen at trace time; thread PRNG keys / clock "
+                      "values through the state instead"))
+        return
+    if d in ("int", "float", "bool") and any(tainter.taint(a) for a in args):
+        findings.add((node.lineno, "purity-host-coerce",
+                      f"`{d}()` on a traced value forces a host sync inside "
+                      "the trace; use .astype/jnp casts"))
+    if (isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("item", "tolist")
+            and tainter.taint(node.func.value)):
+        findings.add((node.lineno, "purity-host-coerce",
+                      f"`.{node.func.attr}()` on a traced value forces a "
+                      "host sync inside the trace"))
+    if (root in np_aliases and "random" not in d
+            and any(tainter.taint(a) for a in args)):
+        findings.add((node.lineno, "purity-np-call",
+                      f"bare `{d}` on traced data inside jitted code "
+                      "materializes the tracer on the host; use the jnp "
+                      "equivalent"))
+    for kw in node.keywords:
+        if kw.arg == "dtype":
+            dt = dotted_name(kw.value) or (
+                kw.value.value if isinstance(kw.value, ast.Constant) else "")
+            if isinstance(dt, str) and dt.split(".")[-1] in (
+                    "float64", "int64", "float", "int"):
+                findings.add((node.lineno, "purity-dtype64",
+                              f"dtype `{dt}` in jit-reachable code breaks "
+                              "the engine's int32/float32 discipline"))
+
+
+def check_dtype_attrs(mod: Module, graph: CallGraph) -> list[Finding]:
+    """Explicit 64-bit dtype attribute references in reachable code."""
+    findings: set[tuple] = set()
+    for key, info in graph.functions.items():
+        if info.module is not mod or key not in graph.reachable:
+            continue
+        for node in ast.walk(info.node):
+            d = dotted_name(node) if isinstance(node, ast.Attribute) else None
+            if d in _DTYPE64_ATTRS:
+                findings.add((node.lineno, "purity-dtype64",
+                              f"`{d}` in jit-reachable code breaks the "
+                              "engine's int32/float32 discipline"))
+    return [Finding(mod.path, line, rule, msg)
+            for (line, rule, msg) in sorted(findings)]
