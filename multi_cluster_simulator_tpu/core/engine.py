@@ -39,6 +39,8 @@ concurrent goroutines — see PARITY.md):
 from __future__ import annotations
 
 import functools
+from typing import Sequence
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -243,14 +245,14 @@ def pack_arrivals(arr: Arrivals) -> tuple[jax.Array, jax.Array]:
     return rows, arr.n
 
 
-def pack_arrivals_by_tick(arr: Arrivals, n_ticks: int,
-                          tick_ms: int) -> st.TickArrivals:
-    """Bucket the stream by destination tick (host-side numpy, once per
-    run): a job arriving at ``ta`` is ingested at the first tick whose
-    clock ``t = k * tick_ms`` satisfies ``ta <= t`` — exactly the engine's
-    ``due`` rule and Go's per-tick drain of everything already posted
-    (server.go:53-78 + the 1 s loop). Arrivals beyond the horizon are
-    dropped here exactly as the windowed path never reaches them."""
+def _bucket_arrivals_host(arr: Arrivals, n_ticks: int, tick_ms: int):
+    """The shared host-side bucketing core behind ``pack_arrivals_by_tick``
+    and ``pack_arrivals_chunks``: computes each arrival's destination tick
+    and rank-in-tick without materializing any padded rows tensor.
+
+    Returns ``(fields [C, A, NF], dest [C, A], ok [C, A], rank [C, A],
+    counts [T, C])`` — ``ok`` marks arrivals landing inside the horizon,
+    ``dest`` parks the rest on a virtual overflow tick ``n_ticks``."""
     t = np.asarray(arr.t)
     C, A = t.shape
     n = np.asarray(arr.n)
@@ -272,21 +274,98 @@ def pack_arrivals_by_tick(arr: Arrivals, n_ticks: int,
     firsts = np.zeros((C, n_ticks + 1), np.int64)
     firsts[:, 1:] = np.cumsum(counts2d, axis=1)[:, :-1]
     rank = np.arange(A)[None, :] - firsts[np.arange(C)[:, None], dest]
-    K = max(int(counts2d[:, :n_ticks].max(initial=1)), 1)
-    rows = np.broadcast_to(np.asarray(Q._INVALID_ROW),
-                           (n_ticks, C, K, Q.NF)).copy()
     fields = np.stack([np.asarray(arr.id), np.asarray(arr.cores),
                        np.asarray(arr.mem), np.asarray(arr.gpu),
                        np.asarray(arr.dur), t,
                        np.full_like(t, int(Q.OWN)),
                        np.zeros_like(t)], axis=-1)  # [C, A, NF]
+    return fields, dest, ok, rank, counts2d.T[:n_ticks].copy()
+
+
+def pack_arrivals_by_tick(arr: Arrivals, n_ticks: int,
+                          tick_ms: int) -> st.TickArrivals:
+    """Bucket the stream by destination tick (host-side numpy, once per
+    run): a job arriving at ``ta`` is ingested at the first tick whose
+    clock ``t = k * tick_ms`` satisfies ``ta <= t`` — exactly the engine's
+    ``due`` rule and Go's per-tick drain of everything already posted
+    (server.go:53-78 + the 1 s loop). Arrivals beyond the horizon are
+    dropped here exactly as the windowed path never reaches them.
+
+    Rows are padded to the STREAM-GLOBAL max arrivals-per-tick ``K``; at
+    trace-scale burstiness that tensor is mostly padding and can be GBs —
+    chunked drivers should use ``pack_arrivals_chunks``, which pads each
+    chunk to its own max instead."""
+    fields, dest, ok, rank, counts = _bucket_arrivals_host(arr, n_ticks,
+                                                           tick_ms)
+    C = fields.shape[0]
+    K = max(int(counts.max(initial=1)), 1)
+    rows = np.broadcast_to(np.asarray(Q._INVALID_ROW),
+                           (n_ticks, C, K, Q.NF)).copy()
     cc, aa = np.nonzero(ok)
     rows[dest[cc, aa], cc, rank[cc, aa]] = fields[cc, aa]
     # host numpy, not device arrays: the bucketed tensor can be GBs at
     # trace scale, and callers chunk/shard it — committing it to the
     # default device here would hold a full extra HBM copy alive next to
     # the per-chunk placements (jit transfers numpy leaves on use)
-    return st.TickArrivals(rows=rows, counts=counts2d.T[:n_ticks].copy())
+    return st.TickArrivals(rows=rows, counts=counts)
+
+
+def round_up_pow2(k: int) -> int:
+    """Smallest power of two >= k (>= 1). The K-bucket rounding that bounds
+    the number of distinct chunk shapes — and hence XLA compiles — at
+    log2(max K) for a whole run."""
+    return 1 << max(int(k) - 1, 0).bit_length()
+
+
+def pack_arrivals_chunks(arr: Arrivals, chunk_sizes: Sequence[int],
+                         tick_ms: int, start: int = 0,
+                         k_bucket=round_up_pow2) -> list[st.TickArrivals]:
+    """Ragged per-chunk bucketing: ``pack_arrivals_by_tick`` for a chunked
+    driver, padding each chunk's ``[ticks, C, K_chunk, NF]`` rows tensor to
+    that CHUNK's own max arrivals-per-tick instead of the stream-global max.
+    ``K_chunk`` is rounded up by ``k_bucket`` (powers of two by default) so
+    the per-chunk run functions compile once per bucket, not once per
+    chunk. Chunk ``i`` covers ticks ``[start + sum(chunk_sizes[:i]),
+    start + sum(chunk_sizes[:i+1]))``; ``start`` supports checkpoint-resumed
+    drivers that re-bucket only the remaining ticks.
+
+    Semantically identical to slicing the global-K tensor: ingest masks
+    rows beyond each tick's count (``_ingest_packed_local``), so padding
+    width is invisible to the simulation — only to HBM and the H2D link.
+    All tensors are host numpy; callers stream them to the device
+    (bench._engine_run double-buffers the transfer under the previous
+    chunk's scan)."""
+    n_ticks = start + sum(chunk_sizes)
+    fields, dest, ok, rank, counts = _bucket_arrivals_host(arr, n_ticks,
+                                                           tick_ms)
+    C = fields.shape[0]
+    cc, aa = np.nonzero(ok)
+    d, r = dest[cc, aa], rank[cc, aa]
+    # one stable sort by destination tick, then each chunk is a contiguous
+    # slice (searchsorted) — not a per-chunk mask over the whole stream,
+    # which would be O(chunks x arrivals) host work at trace scale
+    order = np.argsort(d, kind="stable")
+    d, cc, aa, r = d[order], cc[order], aa[order], r[order]
+    bounds = np.searchsorted(
+        d, np.cumsum([start] + list(chunk_sizes)))
+    # clamp buckets at the exact stream-global max: pow2 rounding must
+    # never pad a chunk PAST what the global-K path would have used (a
+    # near-uniform stream whose max is e.g. 6 should not inflate to 8) —
+    # the shape set stays bounded: {pow2 < K_global} ∪ {K_global}
+    k_global = max(int(counts.max(initial=1)), 1)
+    out = []
+    off = start
+    for i, nt in enumerate(chunk_sizes):
+        kc = int(counts[off:off + nt].max(initial=0))
+        K = max(min(int(k_bucket(max(kc, 1))), k_global), kc, 1)
+        rows = np.broadcast_to(np.asarray(Q._INVALID_ROW),
+                               (nt, C, K, Q.NF)).copy()
+        sl = slice(bounds[i], bounds[i + 1])
+        rows[d[sl] - off, cc[sl], r[sl]] = fields[cc[sl], aa[sl]]
+        out.append(st.TickArrivals(rows=rows,
+                                   counts=counts[off:off + nt].copy()))
+        off += nt
+    return out
 
 
 def _ingest_packed_local(s: SimState, rows: jax.Array, cnt: jax.Array, t,
@@ -404,7 +483,14 @@ def _delay_local(s: SimState, t, cfg: SimConfig):
     init = (s, jnp.int32(0), s.l1.rec_wait,
             jnp.zeros((cfg.queue_capacity,), bool), jnp.zeros((), bool),
             jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0))
+    t_in = s.t
     s, _, rec, placed, _, buf, cnt = jax.lax.while_loop(cond, step, init)
+    # the loop never writes the clock, but under vmap a batched loop
+    # predicate makes older jax batching rules batch EVERY carry leaf —
+    # including the replicated scalar t, which then trips the engine's
+    # out_axes=None spec. Restoring the pre-loop leaf is a semantic no-op
+    # that keeps t replicated on every jax version.
+    s = s.replace(t=t_in)
     l1 = Q.compact(Q.set_col(s.l1, Q.FREC, rec), jnp.logical_not(placed))
     s = s.replace(l1=l1, run=R.start_many(s.run, buf, cnt))
     return _delay_l0_head(s, t, cfg)
@@ -520,9 +606,12 @@ def _ffd_local(s: SimState, t, cfg: SimConfig):
         placed = jnp.logical_or(placed, jnp.logical_and(hot, success))
         return (s2, k + 1, placed, buf, cnt)
 
+    t_in = s.t
     s, _, placed, buf, cnt = jax.lax.while_loop(
         cond, step, (s, jnp.int32(0), jnp.zeros((cfg.queue_capacity,), bool),
                      jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0)))
+    # keep the replicated clock out of the batched carry (see _delay_local)
+    s = s.replace(t=t_in)
     return s.replace(l0=Q.compact(s.l0, jnp.logical_not(placed)),
                      run=R.start_many(s.run, buf, cnt))
 
@@ -871,8 +960,11 @@ def _fifo_local(s: SimState, t, cfg: SimConfig):
         init = (s, jnp.int32(0), jnp.zeros((), bool), jnp.int32(0),
                 Q.JobRec.invalid(), jnp.zeros((), bool),
                 jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0))
+        t_in = s.t
         s, _, _, n_taken, fail_job, any_fail, buf, cnt = jax.lax.while_loop(
             dcond, dstep, init)
+        # keep the replicated clock out of the batched carry (_delay_local)
+        s = s.replace(t=t_in)
     # the drain consumes a strict prefix of the ready queue; its placements
     # flush into the set before the wait-head attempt reads occupancy
     s = s.replace(run=R.start_many(s.run, buf, cnt),
@@ -1142,7 +1234,15 @@ class Engine:
         state, series = jax.lax.scan(body, state, None, length=n_ticks)
         return (state, series) if record else state
 
-    def run_jit(self):
+    def run_jit(self, donate: bool = False):
         """A jitted ``run``: (state, arrivals, n_ticks-static) -> state, or
-        (state, MetricSample series) when cfg.record_metrics is set."""
-        return jax.jit(self.run, static_argnums=(2,))
+        (state, MetricSample series) when cfg.record_metrics is set.
+
+        ``donate=True`` donates the input ``SimState`` buffers to the call
+        (``donate_argnums``), so the state is updated in place in HBM
+        instead of double-buffered — the chunked drivers thread one state
+        through many calls and never reread an input. The caller's state
+        arrays are INVALID after the call; clone first (``jnp.copy``) if
+        the initial state must survive, e.g. for repeat timings."""
+        return jax.jit(self.run, static_argnums=(2,),
+                       donate_argnums=(0,) if donate else ())

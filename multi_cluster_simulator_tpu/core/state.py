@@ -53,10 +53,22 @@ class TickArrivals:
     tick at 4k clusters). K is the maximum arrivals any (tick, cluster) pair
     receives, computed from the data — ingest can never defer, making the
     bucketed run observably identical to Go's unbounded ingest by
-    construction."""
+    construction.
+
+    K may be the stream-global max (pack_arrivals_by_tick) or a per-chunk
+    max when the run is chunked (engine.pack_arrivals_chunks): ingest masks
+    rows beyond each tick's count, so the padding width K is invisible to
+    the simulation — ragged chunks are how the streamed bench pipeline
+    keeps burst padding off the H2D link (ARCHITECTURE.md §chunk
+    pipeline)."""
 
     rows: jax.Array  # [T, C, K, Q.NF] pre-packed queue rows per tick
     counts: jax.Array  # [T, C] int32 arrivals per (tick, cluster)
+
+    def nbytes(self) -> int:
+        """Total payload bytes — what one host→device transfer of this
+        bucket moves (bench.py reports it as h2d_bytes)."""
+        return int(self.rows.nbytes) + int(self.counts.nbytes)
 
 
 @struct.dataclass

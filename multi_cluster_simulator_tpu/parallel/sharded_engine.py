@@ -11,9 +11,27 @@ just swaps the exchange implementation.
 
 from __future__ import annotations
 
+import inspect
+
 import jax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
+
+# jax < 0.5 ships shard_map under jax.experimental; newer jax promotes it
+# to jax.shard_map. The disable-the-replication-check kwarg was also
+# renamed (check_rep -> check_vma) on a different schedule than the
+# promotion, so pick the spelling from the chosen function's signature.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - exercised on jax<0.5 CI images
+    from jax.experimental.shard_map import shard_map as _shard_map
+try:
+    _SHARD_MAP_KW = (
+        {"check_vma": False}
+        if "check_vma" in inspect.signature(_shard_map).parameters
+        else {"check_rep": False})
+except (TypeError, ValueError):  # pragma: no cover - unintrospectable wrap
+    _SHARD_MAP_KW = {}
 
 from multi_cluster_simulator_tpu.config import SimConfig
 from multi_cluster_simulator_tpu.core.engine import Engine
@@ -84,11 +102,16 @@ class ShardedEngine:
                  else _arr_specs(self.axis))
         return _device_put_tree(arrivals, specs, self.mesh, place)
 
-    def run_fn(self, n_ticks: int, tick_indexed: bool = False):
+    def run_fn(self, n_ticks: int, tick_indexed: bool = False,
+               donate: bool = False):
         """A jitted (state, arrivals) -> state advancing n_ticks under
         shard_map (``(state, MetricSample)`` when cfg.record_metrics: the
         [T, C] series stays cluster-sharded on its second axis).
-        ``tick_indexed=True`` takes TickArrivals instead of a stream."""
+        ``tick_indexed=True`` takes TickArrivals instead of a stream.
+        ``donate=True`` donates the sharded input state's buffers so the
+        multi-GB constellation state is updated in place per shard instead
+        of double-buffered in HBM (same contract as Engine.run_jit: the
+        caller's state arrays are invalid after the call)."""
         eng = self.engine
 
         def body(state, arrivals):
@@ -102,12 +125,12 @@ class ShardedEngine:
                 avg_wait_ms=P(None, self.axis)))
         arr_specs = (_tick_arr_specs(self.axis) if tick_indexed
                      else _arr_specs(self.axis))
-        mapped = jax.shard_map(
+        mapped = _shard_map(
             body, mesh=self.mesh,
             in_specs=(_state_specs(self.axis), arr_specs),
             out_specs=out_specs,
-            check_vma=False)
-        return jax.jit(mapped)
+            **_SHARD_MAP_KW)
+        return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
 def _device_put_tree(tree, spec_prefix, mesh, place=None):

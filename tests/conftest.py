@@ -23,8 +23,30 @@ jax.config.update("jax_compilation_cache_dir",
                   os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
+import json  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Fast-signal-first test order. The tier-1 gate runs under a wall-clock
+# budget (ROADMAP.md), so tests execute in ascending measured cost: quick
+# failures surface in the first seconds, and a budget cutoff truncates only
+# the slowest parity/equivalence soaks instead of an alphabetical-order
+# prefix. Costs come from tests/timings.json — regenerate with
+#   pytest tests/ -q -m 'not slow' --durations=0 --durations-min=0.001
+# and tools/collect_test_timings.py. Tests without an entry (new tests)
+# sort at 5 s: after the sub-second signal wall, before the soaks.
+_TIMINGS_PATH = os.path.join(os.path.dirname(__file__), "timings.json")
+try:
+    with open(_TIMINGS_PATH) as _f:
+        _TIMINGS = json.load(_f)
+except (OSError, ValueError):
+    _TIMINGS = {}
+
+
+def pytest_collection_modifyitems(config, items):
+    if _TIMINGS:
+        items.sort(key=lambda it: float(_TIMINGS.get(it.nodeid, 5.0)))
 
 from multi_cluster_simulator_tpu.config import SimConfig, WorkloadConfig  # noqa: E402
 from multi_cluster_simulator_tpu.core.spec import load_cluster_json  # noqa: E402

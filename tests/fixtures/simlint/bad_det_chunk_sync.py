@@ -1,0 +1,31 @@
+"""Fixture: blocking host coercions inside the chunk loop of an
+`_engine_run`-style driver — each one stalls async dispatch at the chunk
+boundary, so the next chunk's H2D transfer serializes behind the previous
+chunk's compute instead of hiding under it (det-chunk-sync). The clean
+form of the same driver is good_det_chunk_sync.py."""
+
+import jax
+import numpy as np
+
+
+def drive(step, state, chunks):
+    for arr in chunks:
+        state = step(state, arr)
+        np.asarray(state.t)  # BAD: forces a host read every chunk
+    return state
+
+
+def drive_blocking(step, state, chunks):
+    i = 0
+    while i < len(chunks):
+        state = step(state, chunks[i])
+        jax.block_until_ready(state)  # BAD: waits out every chunk
+        i += 1
+    return state
+
+
+def drive_method_sync(step, state, chunks):
+    for arr in chunks:
+        state = step(state, arr)
+        state.t.block_until_ready()  # BAD: same stall, method form
+    return state

@@ -1,0 +1,16 @@
+"""Fixture: the clean chunk-dispatch pipeline — double-buffered prefetch
+inside the loop, exactly one host sync AFTER it (bench._engine_run's
+shape). No det-chunk-sync finding; pair of bad_det_chunk_sync.py."""
+
+import jax
+import numpy as np
+
+
+def drive(step, put, state, chunks):
+    nxt = put(chunks[0])
+    for i in range(len(chunks)):
+        state = step(state, nxt)  # async dispatch
+        if i + 1 < len(chunks):
+            nxt = put(chunks[i + 1])  # H2D hides under the scan above
+    state = jax.block_until_ready(state)  # one sync, after the loop
+    return np.asarray(state.t)

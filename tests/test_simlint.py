@@ -66,6 +66,7 @@ FIXTURE_RULES = [
     ("bad_lockset.py", "lock-holds-violation"),
     ("bad_det_set.py", "det-unordered-iter"),
     ("bad_det_wallclock.py", "det-wallclock"),
+    ("bad_det_chunk_sync.py", "det-chunk-sync"),
     ("bad_pragma.py", "pragma-no-reason"),
     ("bad_pragma.py", "pragma-stale"),
 ]
@@ -89,6 +90,41 @@ def test_cli_exits_nonzero_on_fixture(fixture):
 def test_rules_are_known():
     for _, rule in FIXTURE_RULES:
         assert rule in ALL_RULES
+
+
+def test_good_chunk_pipeline_fixture_is_clean():
+    """The paired clean driver — prefetch in the loop, one sync after it —
+    must NOT trip det-chunk-sync (the rule keys on coercions inside the
+    loop body, not on the driver shape itself)."""
+    findings = run(str(FIXTURES / "good_det_chunk_sync.py"))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    proc = _cli(str(FIXTURES / "good_det_chunk_sync.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_bench_chunk_loop_is_clean_of_blocking_coercions():
+    """The real chunked driver (bench._engine_run) carries exactly one
+    justified host sync in its chunk loop — the checkpoint save — and it
+    must stay pragma-suppressed with a reason; anything else is a pipeline
+    stall regression."""
+    findings = run(str(REPO / "bench.py"), rules=("det-chunk-sync",))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_bench_chunk_rule_engages_with_the_real_driver(tmp_path):
+    """det-chunk-sync provably reaches bench.py's actual chunk loop: strip
+    the suppression pragma and the checkpoint save's block_until_ready must
+    surface — so the clean result above can never mean 'checked nothing'."""
+    src = (REPO / "bench.py").read_text()
+    assert "simlint: ignore[det-chunk-sync]" in src
+    bad = "\n".join(ln for ln in src.splitlines()
+                    if "simlint: ignore[det-chunk-sync]" not in ln
+                    and "# the chunk must be complete on device" not in ln
+                    and "# serialized, and saves are off in every" not in ln)
+    f = tmp_path / "bench_nopragma.py"
+    f.write_text(bad)
+    assert any(x.rule == "det-chunk-sync"
+               for x in run(str(f), rules=("det-chunk-sync",)))
 
 
 # ---------------------------------------------------------------------------

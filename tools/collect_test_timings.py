@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Regenerate tests/timings.json — the measured per-test costs that drive
+the fast-signal-first collection order (tests/conftest.py).
+
+Usage:
+  python -m pytest tests/ -q -m 'not slow' --durations=0 \
+      --durations-min=0.001 2>&1 | tee /tmp/durations.log
+  python tools/collect_test_timings.py /tmp/durations.log
+
+Only 'call' phases are recorded (setup/teardown are shared fixture noise).
+Durations are machine-relative; only the ORDER matters, so a stale file
+degrades gracefully — new tests default to mid-cost until remeasured.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+_LINE = re.compile(r"^\s*([0-9.]+)s\s+call\s+(\S+)\s*$")
+
+
+def collect(log_path: str) -> dict[str, float]:
+    out: dict[str, float] = {}
+    with open(log_path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            m = _LINE.match(line)
+            if m:
+                out[m.group(2)] = round(float(m.group(1)), 3)
+    return out
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    timings = collect(sys.argv[1])
+    if not timings:
+        print(f"no '<seconds>s call <nodeid>' lines in {sys.argv[1]}",
+              file=sys.stderr)
+        return 1
+    dst = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "..", "tests", "timings.json")
+    with open(dst, "w") as f:
+        json.dump(dict(sorted(timings.items())), f, indent=0, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {len(timings)} entries to {os.path.normpath(dst)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,15 @@ bit-identically (PARITY.md, MARKET.md), with or without jit.
 - ``det-wallclock`` — wall-clock/RNG reads (``time.time``, ``random.*``,
   ``np.random.*``) anywhere in tick-path files, jitted or not: replay of
   the same trace must produce the same states.
+- ``det-chunk-sync`` — blocking host coercions (``np.asarray``/``np.array``,
+  ``jax.device_get``, ``.block_until_ready()``) inside the chunk loop of an
+  ``_engine_run``-style driver: a loop that threads loop-carried state
+  through a step call (``s = step(s, ...)``) is the async dispatch pipeline,
+  and a host sync in its body stalls every chunk boundary — the H2D
+  prefetch can no longer hide under the previous chunk's scan
+  (ARCHITECTURE.md §chunk pipeline). Hoist the coercion after the loop, or
+  suppress with a written reason where the sync is the point (checkpoint
+  durability, timing reads).
 """
 
 from __future__ import annotations
@@ -62,6 +71,62 @@ def _is_unsorted_fs_listing(expr) -> bool:
     d = dotted_name(expr.func) or ""
     return d in _FS_LISTING or (isinstance(expr.func, ast.Attribute)
                                 and expr.func.attr == "iterdir")
+
+
+def _loop_carried_names(loop) -> set:
+    """Names threaded through a call in the loop body (``s = step(s, ...)``)
+    — the chunk-pipeline idiom: loop-carried device state fed back into a
+    dispatch. Tuple targets count per element (``s, ser = step(s, a)``)."""
+    carried: set = set()
+    for node in ast.walk(loop):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        tgts: set = set()
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                tgts.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                tgts |= {e.id for e in t.elts if isinstance(e, ast.Name)}
+        args = {n.id for n in ast.walk(node.value) if isinstance(n, ast.Name)}
+        carried |= tgts & args
+    return carried
+
+
+def _chunk_sync_findings(mod: Module) -> set:
+    """``det-chunk-sync``: host coercions inside chunk-dispatch loops."""
+    np_aliases = frozenset(
+        {a for a, m in mod.module_aliases.items() if m == "numpy"})
+    jax_aliases = frozenset(
+        {a for a, m in mod.module_aliases.items() if m == "jax"})
+    blocking_fns = ({f"{a}.asarray" for a in np_aliases}
+                    | {f"{a}.array" for a in np_aliases}
+                    | {f"{a}.device_get" for a in jax_aliases}
+                    | {f"{a}.block_until_ready" for a in jax_aliases})
+    found: set = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        if not _loop_carried_names(node):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted_name(sub.func) or ""
+            is_method_sync = (isinstance(sub.func, ast.Attribute)
+                              and sub.func.attr == "block_until_ready")
+            if d in blocking_fns or is_method_sync:
+                label = d or f".{sub.func.attr}()"
+                found.add((sub.lineno, "det-chunk-sync",
+                           f"blocking host coercion `{label}` inside a "
+                           "chunk-dispatch loop (loop-carried state "
+                           "through a step call): it stalls async "
+                           "dispatch at every chunk boundary, so H2D "
+                           "prefetch can no longer hide under the "
+                           "previous chunk's scan — hoist it after the "
+                           "loop or suppress with the reason the sync "
+                           "is required"))
+    return found
 
 
 def check_module(mod: Module) -> list[Finding]:
@@ -120,6 +185,8 @@ def check_module(mod: Module) -> list[Finding]:
                           "the replay contract is bit-identical states "
                           "from identical inputs — derive times from the "
                           "virtual clock and randomness from seeded keys"))
+
+    findings |= _chunk_sync_findings(mod)
 
     return [Finding(mod.path, line, rule, msg)
             for (line, rule, msg) in sorted(findings)]

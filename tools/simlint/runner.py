@@ -21,7 +21,7 @@ DET_DIRS = ("core", "ops", "market", "workload")
 PURITY_RULES = ("purity-traced-branch", "purity-wallclock",
                 "purity-host-coerce", "purity-np-call", "purity-dtype64")
 LOCKSET_RULES = ("lock-unguarded-access", "lock-holds-violation")
-DET_RULES = ("det-unordered-iter", "det-wallclock")
+DET_RULES = ("det-unordered-iter", "det-wallclock", "det-chunk-sync")
 PRAGMA_RULES = ("pragma-no-reason", "pragma-stale")
 ALL_RULES = PURITY_RULES + LOCKSET_RULES + DET_RULES + PRAGMA_RULES
 
