@@ -17,7 +17,8 @@ simulated time — per-config speedups vs that bound are in the details file.
 Usage:
   python bench.py                 # headline (north star)
   python bench.py --config NAME   # fifo_small | fifo_two_trader | ffd64 |
-                                  # sinkhorn | borg4k | scale16k | headline
+                                  # sinkhorn | borg4k | sparse_bursts |
+                                  # scale16k | headline
   python bench.py --all           # every config; details to bench_results.json
 """
 
@@ -50,6 +51,40 @@ _PIPELINE = {"mode": "on", "stream": "auto"}
 # resident, the known-good regime; the 4x borg_replay shape that OOMed at
 # ~6.7 GB is what streaming exists for)
 _STREAM_AUTO_BYTES = 6 << 30
+
+# Event-compressed virtual time, set by main() from --time-compress. "off"
+# keeps the dense lax.scan driver (one 7-phase tick per tick_ms); "always"
+# runs every tick-indexed chunk through the leap driver
+# (engine.run_compressed); "auto" picks per chunk — only chunks whose
+# bucketed counts show a quiescent gap worth leaping use the while_loop
+# form, so dense traces (the headline) keep the scan driver and cannot
+# regress. Compression is bit-identical in all modes
+# (tests/test_pipeline.py pins it); only wall-clock changes.
+_TIME_COMPRESS = {"mode": "auto"}
+# auto thresholds: a chunk leaps only if its counts are mostly empty ticks
+# (the while_loop form pays a per-EXECUTED-tick premium over lax.scan —
+# dynamic row indexing, the quiescence/next-event probes, the cross-shard
+# allmin — so with E the empty fraction the potential win is bounded by
+# ~1/(1-E): below half-empty it cannot pay for itself) AND contain at
+# least one gap long enough to leap (short gaps are completion-bound)
+_COMPRESS_AUTO_GAP = 8
+_COMPRESS_AUTO_EMPTY_FRAC = 0.5
+
+
+def _leapable(counts) -> bool:
+    """Host-side per-chunk heuristic for --time-compress auto: does this
+    chunk's bucketed stream look sparse enough for the leap driver to win?
+    Arrival counts are the only event source visible host-side —
+    completions still bound leaps at runtime — so this errs dense: the
+    measured quick-headline drain tail leapt only ~2% of its ticks and
+    the while-form premium made it a net loss, which is exactly what the
+    empty-fraction floor screens out."""
+    empty = ~np.asarray(counts).any(axis=1)
+    if not empty.any() or empty.mean() < _COMPRESS_AUTO_EMPTY_FRAC:
+        return False
+    edges = np.flatnonzero(np.diff(np.concatenate(
+        ([0], empty.astype(np.int8), [0]))))
+    return int((edges[1::2] - edges[::2]).max()) >= _COMPRESS_AUTO_GAP
 
 # persistent-compilation-cache state, set by _setup_jax() so details can
 # report whether compile_s was paid cold or served warm from the cache
@@ -170,6 +205,18 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
             _PIPELINE["stream"] == "always"
             or (_PIPELINE["stream"] == "auto"
                 and arrivals_bytes > _STREAM_AUTO_BYTES))
+    # event-compressed virtual time: per-chunk driver choice (the leap
+    # driver is only defined over pre-bucketed TickArrivals)
+    tc_mode = _TIME_COMPRESS["mode"]
+    comp_flags = [False] * len(chunks)
+    # auto also declines metric-recording runs: the compressed driver's
+    # series reconstruction rewrites the whole [T, C] buffers per executed
+    # tick, which beats the dense scan only at compression ratios no bench
+    # config reaches ("always" still forces it — the tests need that)
+    if tick_indexed and tc_mode != "off" and not (
+            tc_mode == "auto" and cfg.record_metrics):
+        comp_flags = [True if tc_mode == "always" else _leapable(a.counts)
+                      for a in arr_host]
     if use_mesh and n_dev > 1 and state.arr_ptr.shape[0] % n_dev == 0:
         from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
         sh = ShardedEngine(cfg, make_mesh(n_dev))
@@ -177,9 +224,10 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
         put = sh.shard_arrivals
         if not tick_indexed:
             arrivals = sh.shard_arrivals(arrivals)
-        fns = {n: sh.run_fn(n, tick_indexed=tick_indexed, donate=pipelined)
-               for n in set(chunks)}
-        step = lambda s, a, n: fns[n](s, a)
+        fns = {(n, c): sh.run_fn(n, tick_indexed=tick_indexed,
+                                 donate=pipelined, time_compress=c)
+               for n, c in set(zip(chunks, comp_flags))}
+        step = lambda s, a, n, c: fns[(n, c)](s, a)
     else:
         put = jax.device_put
         if not tick_indexed:
@@ -187,7 +235,9 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
         eng = Engine(cfg)
         jfn = jax.jit(eng.run, static_argnums=(2,),
                       donate_argnums=(0,) if pipelined else ())
-        step = lambda s, a, n: jfn(s, a, n)
+        cfn = (eng.run_compressed_jit(donate=pipelined)
+               if any(comp_flags) else None)
+        step = lambda s, a, n, c: (cfn if c else jfn)(s, a, n)
     arr_dev = None
     if tick_indexed and not stream:
         # resident regime: the bucketed stream fits comfortably, so chunk
@@ -195,20 +245,40 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
         # repeats reuse the resident buffers — one H2D total
         arr_dev = [put(a) for a in arr_host]
 
+    leap_stats = []  # device LeapStats per compressed chunk, last run's
+
+    def step_norm(s, a, n, comp):
+        """One chunk call with a normalized (state, series?, LeapStats?)
+        return, so the driver loop below keeps a single loop-carried
+        assignment through the call regardless of driver/metrics shape."""
+        out = step(s, a, n, comp)
+        lstats = None
+        if comp:
+            *out, lstats = out
+            out = out[0] if len(out) == 1 else tuple(out)
+        if cfg.record_metrics:
+            s, ser = out
+        else:
+            s, ser = out, None
+        return s, ser, lstats
+
     def run(s, save):
         if pipelined:
             # the chunk calls donate their input state; hand the loop its
             # own device copy so the caller's state survives for repeats
             s = jax.tree.map(jnp.copy, s)
         parts = []
+        leap_stats.clear()
         nxt = put(arr_host[0]) if stream else None
         for i, n in enumerate(chunks):
             a = (nxt if stream else arr_dev[i]) if tick_indexed else arrivals
+            s, ser, lstats = step_norm(s, a, n, comp_flags[i])
+            if lstats is not None:
+                # keep the device LeapStats object — coercing here would
+                # stall the prefetch pipeline
+                leap_stats.append(lstats)
             if cfg.record_metrics:
-                s, ser = step(s, a, n)
                 parts.append(ser)
-            else:
-                s = step(s, a, n)
             if stream and i + 1 < len(chunks):
                 # double-buffered prefetch: the step dispatch above is
                 # async, so chunk i+1's H2D rides under chunk i's scan
@@ -256,6 +326,22 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     info["walls"] = walls
     if warmups:
         info["warmups"] = warmups
+    if tick_indexed:
+        # time-compression provenance: executed vs simulated ticks and the
+        # log2 leap histogram (bucket b = leaps of [2^b, 2^(b+1)) ticks) —
+        # the DES win auditable from BENCH history alone
+        executed = sum(n for n, c in zip(chunks, comp_flags) if not c)
+        executed += sum(int(np.asarray(ls.ticks_executed))
+                        for ls in leap_stats)
+        tc = {"mode": tc_mode, "ticks_simulated": sum(chunks),
+              "ticks_executed": executed,
+              "compressed_chunks": int(sum(comp_flags))}
+        if leap_stats:
+            hist = np.sum([np.asarray(ls.leaps) for ls in leap_stats],
+                          axis=0)
+            nz = np.flatnonzero(hist)
+            tc["leap_hist_log2"] = hist[:nz[-1] + 1].tolist() if len(nz) else []
+        info["time_compress"] = tc
     # pipeline provenance + data-movement accounting: h2d_bytes is what ONE
     # timed run moved host->device (0 when the stream is resident across
     # repeats); arrivals_bytes is the whole bucketed stream's footprint
@@ -293,7 +379,7 @@ def _timing_detail(info):
                "wall_median_s": round(float(np.median(walls)), 3),
                "timing": f"min-of-{len(walls)}"}
     for k in ("pipeline", "h2d_bytes", "arrivals_bytes",
-              "peak_hbm_process_bytes", "compile_cache"):
+              "peak_hbm_process_bytes", "compile_cache", "time_compress"):
         if info.get(k) is not None:
             out[k] = info[k]
     return out
@@ -1127,6 +1213,66 @@ def bench_scale16k(quick=False):
                               repeats=2, extra_note="4x north-star scale")
 
 
+def bench_sparse_bursts(quick=False):
+    """The event-compression config: a burst-sparse trace (Borg-sparsity
+    regime) where the vast majority of ticks are provably no-ops — jobs
+    arrive in 20 s bursts every 5 minutes and fully drain between them, so
+    the leap driver (``--time-compress``, ARCHITECTURE.md §time
+    compression) executes only the burst/drain ticks and leaps the
+    quiescent valleys. The detail's ``time_compress`` block records
+    ticks_executed vs ticks_simulated + the leap histogram; run with
+    ``--time-compress ab`` to record the measured dense-vs-compressed wall
+    comparison on this exact shape."""
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.workload.traces import bursty_stream
+
+    C = 64 if quick else 1024
+    bursts, per_burst = (5, 10) if quick else (12, 24)
+    interval_ms, window_ms = 300_000, 20_000
+    horizon_ms = bursts * interval_ms
+    # FIFO parity semantics (the headline's mode): bounds sized to the
+    # burst shape — per_burst jobs spread over a 20-tick window back up a
+    # few deep at most (the zero-drops assert below is the guard);
+    # durations <= 60 s guarantee full drain inside each 300 s valley
+    cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=32,
+                    max_running=64, max_arrivals=bursts * per_burst,
+                    max_ingest_per_tick=16, parity=True, n_res=2,
+                    max_nodes=5, max_virtual_nodes=0)
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    arrivals = bursty_stream(C, bursts, per_burst, interval_ms, window_ms,
+                             max_cores=8, max_mem=6_000, max_dur_ms=60_000,
+                             seed=11)
+    n_ticks = horizon_ms // cfg.tick_ms + 70  # drain tail
+    out, wall_s, compile_s, _, info = _engine_run(cfg, specs, arrivals,
+                                                  n_ticks, use_mesh=True,
+                                                  chunk=400, repeats=3,
+                                                  warmups=1,
+                                                  tick_indexed=True)
+    placed = int(np.asarray(out.placed_total).sum())
+    total = C * bursts * per_burst
+    assert placed >= 0.99 * total, f"only {placed}/{total} jobs placed"
+    _assert_zero_drops(out, "sparse_bursts")
+    tc = info.get("time_compress", {})
+    if _TIME_COMPRESS["mode"] != "off":
+        assert tc.get("ticks_executed", n_ticks) < tc.get(
+            "ticks_simulated", n_ticks), (
+            "sparse_bursts: the leap driver executed every tick — "
+            f"compression never engaged ({tc})")
+    rate = (placed - info["placed_before_resume"]) / max(wall_s, 1e-9)
+    return {
+        "metric": "sparse_burst_trace_jobs_per_sec",
+        "value": round(rate, 1),
+        "unit": "jobs/s",
+        "vs_baseline": round(rate / (1_000_000 / 60.0), 3),
+        "detail": {"jobs": placed, "clusters": C,
+                   "wall_s": round(wall_s, 3),
+                   "compile_s": round(compile_s, 1),
+                   "sim_horizon_s": n_ticks,
+                   **_timing_detail(info)},
+    }
+
+
 CONFIGS = {
     "headline": bench_headline,
     "parity_tpu": bench_parity_tpu,
@@ -1137,6 +1283,7 @@ CONFIGS = {
     "sinkhorn": bench_sinkhorn,
     "borg4k": bench_borg4k,
     "borg_replay": bench_borg_replay,
+    "sparse_bursts": bench_sparse_bursts,
     "live": bench_live,
 }
 
@@ -1188,6 +1335,14 @@ def main():
                     help="double-buffered per-run H2D streaming of arrival "
                          "chunks: auto streams only when the bucketed "
                          "stream would crowd HBM if kept resident")
+    ap.add_argument("--time-compress", choices=("off", "auto", "always", "ab"),
+                    default="auto",
+                    help="event-compressed virtual time on the tick-indexed "
+                         "drivers: leap over provably-quiescent ticks to the "
+                         "next event (bit-identical to off). auto picks the "
+                         "leap driver per chunk only when the bucketed "
+                         "counts show a quiescent gap; ab runs compressed "
+                         "then dense and records both walls in the detail")
     ap.add_argument("--compile-cache-dir", metavar="DIR", default=None,
                     help="persistent XLA compilation-cache directory "
                          "(default: ./.jax_cache)")
@@ -1200,6 +1355,8 @@ def main():
     _CKPT["resume"] = args.resume
     _TRACE["path"] = args.trace
     _PIPELINE["stream"] = args.stream_arrivals
+    _TIME_COMPRESS["mode"] = ("auto" if args.time_compress == "ab"
+                              else args.time_compress)
 
     def run_one(name):
         # one checkpoint file per config: states from different configs have
@@ -1214,29 +1371,42 @@ def main():
             except TypeError:
                 return fn()
 
+        def ab_compare(res, toggle, restore_mode, detail_key, on_label,
+                       off_label, extra=()):
+            """Shared A/B body for --pipeline ab and --time-compress ab:
+            flip ``toggle["mode"]`` to off, re-run the config, and merge
+            both walls + the speedup into the detail the graders read
+            (bit-equality of the two paths is pinned by
+            tests/test_pipeline.py; this records the wall win). The
+            comparison run must not see the checkpoint the first run just
+            finished writing — with --resume it would load the final
+            state, simulate 0 ticks, and record a ~0 s wall."""
+            saved_ckpt = dict(_CKPT)
+            _CKPT.update(path=None, resume=False)
+            toggle["mode"] = "off"
+            off = call()
+            toggle["mode"] = restore_mode
+            _CKPT.update(saved_ckpt)
+            d = res.setdefault("detail", {})
+            ab = {f"{on_label}_wall_s": d.get("wall_s"),
+                  f"{off_label}_wall_s": off.get("detail", {}).get("wall_s"),
+                  f"{off_label}_value": off.get("value")}
+            for k in extra:
+                ab[k] = d.get("time_compress", {}).get(k)
+            if ab[f"{on_label}_wall_s"] and ab[f"{off_label}_wall_s"]:
+                ab["speedup"] = round(
+                    ab[f"{off_label}_wall_s"] / ab[f"{on_label}_wall_s"], 3)
+            d[detail_key] = ab
+
         _PIPELINE["mode"] = "on" if args.pipeline == "ab" else args.pipeline
         res = call()
         if args.pipeline == "ab" and name not in ("parity_tpu", "live"):
-            # measured pipelined-vs-unpipelined comparison, recorded in the
-            # artifact the graders read (bit-equality of the two paths is
-            # pinned by tests/test_pipeline.py; this records the wall win).
-            # The comparison run must not see the checkpoint the pipelined
-            # run just finished writing — with --resume it would load the
-            # final state, simulate 0 ticks, and record a ~0 s wall
-            saved_ckpt = dict(_CKPT)
-            _CKPT.update(path=None, resume=False)
-            _PIPELINE["mode"] = "off"
-            off = call()
-            _PIPELINE["mode"] = "on"
-            _CKPT.update(saved_ckpt)
-            d = res.setdefault("detail", {})
-            ab = {"pipelined_wall_s": d.get("wall_s"),
-                  "unpipelined_wall_s": off.get("detail", {}).get("wall_s"),
-                  "unpipelined_value": off.get("value")}
-            if ab["pipelined_wall_s"] and ab["unpipelined_wall_s"]:
-                ab["speedup"] = round(
-                    ab["unpipelined_wall_s"] / ab["pipelined_wall_s"], 3)
-            d["pipeline_ab"] = ab
+            ab_compare(res, _PIPELINE, "on", "pipeline_ab",
+                       "pipelined", "unpipelined")
+        if args.time_compress == "ab" and name not in ("parity_tpu", "live"):
+            ab_compare(res, _TIME_COMPRESS, "auto", "time_compress_ab",
+                       "compressed", "dense",
+                       extra=("ticks_executed", "ticks_simulated"))
         return res
 
     # quick runs are smoke shapes — never let them clobber the full-run
@@ -1256,15 +1426,19 @@ def main():
     else:
         head = run_one(args.config)
         # keep the per-config entry in the record fresh (merge, don't drop
-        # the other configs' results)
-        try:
-            with open(results_path) as f:
-                results = json.load(f)
-        except (OSError, ValueError):
-            results = {}
-        results[args.config] = head
-        with open(results_path, "w") as f:
-            json.dump(results, f, indent=2)
+        # the other configs' results) — except in the live child, which
+        # re-enters main() in a subprocess: its partial single-config view
+        # would transiently clobber the record the parent is about to merge
+        # into (ADVICE r5)
+        if os.environ.get("MCS_LIVE_CHILD") != "1":
+            try:
+                with open(results_path) as f:
+                    results = json.load(f)
+            except (OSError, ValueError):
+                results = {}
+            results[args.config] = head
+            with open(results_path, "w") as f:
+                json.dump(results, f, indent=2)
         head = dict(head)
 
     detail = head.pop("detail", None)

@@ -155,6 +155,138 @@ def _record_wait(total, rec_wait, enq_t, t, do):
 
 
 # --------------------------------------------------------------------------
+# time compression: quiescence predicate + next-event probe + leap accrual
+# (the event-compressed driver, Engine.run_compressed)
+# --------------------------------------------------------------------------
+
+def _quiescence_sig(state: SimState) -> jax.Array:
+    """Fixed-point fingerprint for the leap driver: a vector of scalar
+    sums that changes whenever a tick mutates anything the NEXT tick's
+    decisions can read. Queue membership, placements, completions,
+    arrivals, node activations, and every drop counter are covered; the
+    fields deliberately excluded — the clock, wait accounting
+    (wait_total / FREC), and the trader's snapshot/cooldown/lock columns —
+    either evolve in closed form over a leap or are only read at cadence
+    boundaries the driver never skips (market.trader.next_cadence_t).
+
+    Two executed ticks with equal fingerprints around an event-free gap
+    therefore prove every tick in the gap is a no-op modulo wait accrual:
+    the pass is a pure function of (queues, nodes, run, t), and its only
+    t-dependence is wait recording plus the promotion threshold, which the
+    next-event probe handles (``_next_event_t``)."""
+    d = state.drops
+    parts = [
+        jnp.sum(state.placed_total), jnp.sum(state.arr_ptr),
+        jnp.sum(state.run.active.astype(jnp.int32)),
+        jnp.sum(state.l0.count), jnp.sum(state.l1.count),
+        jnp.sum(state.ready.count), jnp.sum(state.wait.count),
+        jnp.sum(state.lent.count), jnp.sum(state.borrowed.count),
+        jnp.sum(state.node_active.astype(jnp.int32)),
+        jnp.sum(d.queue) + jnp.sum(d.msgs) + jnp.sum(d.run_full)
+        + jnp.sum(d.vslot) + jnp.sum(d.carve) + jnp.sum(d.ingest),
+    ]
+    return jnp.stack([p.astype(jnp.int32) for p in parts])
+
+
+def _next_event_t(state: SimState, t, cfg: SimConfig) -> jax.Array:
+    """Earliest future virtual time at which a quiescent constellation can
+    change state again (shard-local; the driver ``allmin``s across shards
+    and folds in the next nonempty arrival tick separately):
+
+    - a completion: min ``end_t`` over the RunningSet (R.next_end_t) —
+      releases fire at the first tick clock >= end_t;
+    - a DELAY Level0->Level1 promotion: at a fixed point the head keeps
+      failing, so it promotes at the first tick clock >=
+      ``enq_t + max_wait_ms`` (scheduler.go:348-366);
+    - a market cadence boundary (stream snapshot / monitor round) and, in
+      sane mode, a virtual-node expiry.
+
+    Values are raw event times; the driver rounds up to the tick grid."""
+    ev = jnp.min(jax.vmap(R.next_end_t)(state.run))
+    if cfg.policy == PolicyKind.DELAY:
+        head_enq = state.l0.data[:, 0, Q.FENQ]  # [C]
+        promote = jnp.where(state.l0.count > 0,
+                            head_enq + jnp.int32(cfg.max_wait_ms), R.NEVER)
+        ev = jnp.minimum(ev, jnp.min(promote))
+    if cfg.trader.enabled:
+        from multi_cluster_simulator_tpu.market.trader import next_cadence_t
+        ev = jnp.minimum(ev, next_cadence_t(t, cfg.trader))
+        if cfg.trader.expire_virtual_nodes:
+            ev = jnp.minimum(ev, jnp.min(jnp.where(
+                state.node_active, state.node_expire, R.NEVER)))
+    return ev
+
+
+def _leap_wait_masks_local(s: SimState, cfg: SimConfig):
+    """Queue slots whose wait clock the scheduling pass advances every tick
+    at a placement fixed point — exactly the slots the dense pass calls
+    ``_record_wait`` on when nothing places: (l0_mask, l1_mask), single
+    cluster view. FIFO records no wait in the pass, DELAY processes the
+    first ``min(|L1|, QC)`` Level1 slots plus the Level0 head, FFD the
+    first ``min(|L0|, QC)`` slots in best-fit-decreasing order."""
+    cap0 = s.l0.capacity
+    if cfg.policy == PolicyKind.FIFO:
+        z = jnp.zeros((cap0,), bool)
+        return z, jnp.zeros((s.l1.capacity,), bool)
+    QC = _sweep_len(cfg)
+    if cfg.policy == PolicyKind.DELAY:
+        l1_mask = jnp.logical_and(
+            s.l1.slot_valid(),
+            jnp.arange(s.l1.capacity, dtype=jnp.int32)
+            < jnp.minimum(s.l1.count, QC))
+        l0_mask = jnp.logical_and(
+            jnp.arange(cap0, dtype=jnp.int32) == 0, s.l0.count > 0)
+        return l0_mask, l1_mask
+    # FFD: slots selected by the first n_sweep positions of the BFD order
+    order = P.best_fit_decreasing_order(s.l0.cores, s.l0.mem, s.l0.slot_valid())
+    n_sweep = jnp.minimum(s.l0.count, QC)
+    hot = order[:, None] == jnp.arange(cap0, dtype=jnp.int32)[None, :]
+    taken = jnp.arange(cap0, dtype=jnp.int32) < n_sweep  # order positions
+    l0_mask = jnp.any(jnp.logical_and(hot, taken[:, None]), axis=0)
+    return l0_mask, jnp.zeros((s.l1.capacity,), bool)
+
+
+def _leap_local(s: SimState, new_t, do, cfg: SimConfig):
+    """Advance one cluster's wait accounting from ``s.t`` to ``new_t`` in
+    closed form — the per-tick ``_record_wait`` deltas over a quiescent gap
+    telescope: TotalTime -= map[id]; map[id] = since(enqueue); TotalTime +=
+    map[id] per tick sums to ``new_cur - old_rec`` per still-queued
+    processed slot (scheduler.go:309-312). Returns ``(state', rate)`` with
+    ``rate`` the per-tick f32 accrual (processed slots x tick_ms) the
+    metric reconstruction uses for the skipped samples.
+
+    ``do`` is the quiescence vote and must gate the whole accrual, not
+    just the leap distance: after a NON-quiescent tick the masks below are
+    computed from post-tick state and can cover slots the pass did not
+    process this tick (a successor rotated into the Level0 head, say),
+    whose stale FREC would accrue a delta the dense driver only records a
+    tick later — wrong at a run or chunk boundary even though it
+    telescopes out mid-run.
+
+    Bit-parity domain: the dense path folds one float32 add per tick (per
+    slot in the serial sweeps); the closed form adds the telescoped sum
+    once. Both are exact — hence bit-identical — while the accrued values
+    are integer-valued float32 below 2^24 ms, which every parity surface
+    satisfies by orders of magnitude (PARITY.md §time compression)."""
+    l0_mask, l1_mask = _leap_wait_masks_local(s, cfg)
+    l0_mask = jnp.logical_and(l0_mask, do)
+    l1_mask = jnp.logical_and(l1_mask, do)
+
+    def accrue(q, mask, total):
+        cur = (new_t - q.data[:, Q.FENQ]).astype(jnp.int32)
+        frec = q.data[:, Q.FREC]
+        delta = jnp.where(mask, (cur - frec).astype(jnp.float32), 0.0)
+        q = Q.set_col(q, Q.FREC, jnp.where(mask, cur, frec))
+        return q, total + delta.sum()
+
+    # dense tick order: the Level1 sweep accrues before the Level0 head
+    l1, total = accrue(s.l1, l1_mask, s.wait_total)
+    l0, total = accrue(s.l0, l0_mask, total)
+    rate = (l0_mask.sum() + l1_mask.sum()).astype(jnp.float32) * cfg.tick_ms
+    return s.replace(l0=l0, l1=l1, wait_total=total), rate
+
+
+# --------------------------------------------------------------------------
 # phase 1/2: completions, lent returns, virtual-node expiry
 # --------------------------------------------------------------------------
 
@@ -263,8 +395,11 @@ def _bucket_arrivals_host(arr: Arrivals, n_ticks: int, tick_ms: int):
     if A > 1 and not np.all(np.diff(t, axis=1)[valid[:, 1:]] >= 0):
         raise ValueError("pack_arrivals_by_tick requires per-cluster "
                          "time-sorted arrivals")
-    # destination tick index (0-based scan step); tick k has clock (k+1)*tick_ms
-    dest = np.maximum((t + tick_ms - 1) // tick_ms, 1) - 1
+    # destination tick index (0-based scan step); tick k has clock (k+1)*tick_ms.
+    # Computed in int64: the stream's int32 dtype would wrap `t + tick_ms - 1`
+    # negative for arrivals near 2^31 and bucket a beyond-horizon job into
+    # tick 0 instead of parking it on the overflow tick (ADVICE r5).
+    dest = np.maximum((t.astype(np.int64) + tick_ms - 1) // tick_ms, 1) - 1
     ok = valid & (dest < n_ticks)
     dest = np.where(ok, dest, n_ticks)  # parked on a virtual overflow tick
     # per-cluster arrivals are time-sorted, so same-dest rows are contiguous
@@ -1245,4 +1380,143 @@ class Engine:
         arrays are INVALID after the call; clone first (``jnp.copy``) if
         the initial state must survive, e.g. for repeat timings."""
         return jax.jit(self.run, static_argnums=(2,),
+                       donate_argnums=(0,) if donate else ())
+
+    # -- event-compressed driver --
+    def run_compressed(self, state: SimState, arrivals: st.TickArrivals,
+                       n_ticks: int):
+        """``run`` with event-compressed virtual time: a ``while_loop`` that
+        executes a real 7-phase tick only when something can happen, and
+        otherwise leaps the clock to the next event in one step — the
+        classic fixed-increment -> next-event DES speedup, bit-identical to
+        the dense scan (ARCHITECTURE.md §time compression).
+
+        After each executed tick the driver compares state fingerprints
+        (``_quiescence_sig``): an unchanged fingerprint proves the
+        constellation is at a fixed point, so every tick before the next
+        event — the next nonempty arrival tick (from the pre-bucketed
+        counts), the earliest RunningSet completion, the next DELAY
+        promotion threshold, the next market cadence boundary or vnode
+        expiry (``_next_event_t``) — is a no-op modulo wait accrual, which
+        ``_leap_local`` applies in closed form. Under sharding both the
+        quiescence vote and the leap distance ride the exchange
+        (``alland``/``allmin``), so every shard jumps together.
+
+        Returns ``(state, LeapStats)``, or ``(state, series, LeapStats)``
+        when ``cfg.record_metrics``: the dense per-tick series is
+        reconstructed exactly — executed ticks write their sample at their
+        tick index, skipped ticks replicate the fixed point with the
+        closed-form wait accrual folded into ``avg_wait_ms``."""
+        cfg = self.cfg
+        if not isinstance(arrivals, st.TickArrivals):
+            raise ValueError("time compression requires pre-bucketed "
+                             "TickArrivals (pack_arrivals_by_tick / "
+                             "pack_arrivals_chunks)")
+        if arrivals.rows.shape[0] < n_ticks:
+            raise ValueError(
+                f"TickArrivals covers {arrivals.rows.shape[0]} ticks, "
+                f"run asked for {n_ticks}")
+        record = cfg.record_metrics
+        C = state.arr_ptr.shape[0]
+        stats = st.leap_stats_init()
+        if record:
+            ser0 = st.MetricSample(
+                t=jnp.zeros((n_ticks,), jnp.int32),
+                jobs_in_queue=jnp.zeros((n_ticks, C), jnp.int32),
+                avg_wait_ms=jnp.zeros((n_ticks, C), jnp.float32))
+        else:
+            ser0 = None
+        if n_ticks == 0:
+            return (state, ser0, stats) if record else (state, stats)
+
+        rows, counts = arrivals.rows[:n_ticks], arrivals.counts[:n_ticks]
+        tick = jnp.int32(cfg.tick_ms)
+        t0 = state.t
+        t_end = t0 + jnp.int32(n_ticks) * tick
+        inf_t = t_end + tick  # "no event inside this run"
+        # next nonempty arrival tick index, shard-local: next_arr[i] is the
+        # smallest j >= i with arrivals on any local cluster (n_ticks when
+        # none) — one reverse cummin over the pre-bucketed counts; the
+        # cross-shard min happens on the leap target itself
+        nonempty = jnp.any(counts > 0, axis=1)
+        idxs = jnp.where(nonempty, jnp.arange(n_ticks, dtype=jnp.int32),
+                         jnp.int32(n_ticks))
+        next_arr = jnp.flip(jax.lax.cummin(jnp.flip(idxs)))
+        next_arr = jnp.concatenate(
+            [next_arr, jnp.full((1,), n_ticks, jnp.int32)])
+
+        def cond(carry):
+            return carry[0].t < t_end
+
+        def body(carry):
+            s, stats, ser = carry
+            i = ((s.t - t0) // tick).astype(jnp.int32)  # tick index to run
+            rows_i = jax.lax.dynamic_index_in_dim(rows, i, 0, keepdims=False)
+            cnt_i = jax.lax.dynamic_index_in_dim(counts, i, 0, keepdims=False)
+            sig0 = _quiescence_sig(s)
+            s2 = self._tick(s, (rows_i, cnt_i), emit_io=False,
+                            tick_indexed=True)[0]
+            quiet = self.ex.alland(jnp.all(_quiescence_sig(s2) == sig0))
+            # leap target: the clock of the next tick that must execute
+            ev = jnp.minimum(_next_event_t(s2, s2.t, cfg), inf_t)
+            ev_clock = ((ev + tick - 1) // tick) * tick  # ceil to tick grid
+            na = next_arr[jnp.minimum(i + 1, jnp.int32(n_ticks))]
+            arr_clock = t0 + (na + 1) * tick
+            target = self.ex.allmin(
+                jnp.minimum(jnp.minimum(ev_clock, arr_clock), inf_t))
+            new_t = jnp.where(quiet, jnp.maximum(target - tick, s2.t), s2.t)
+            n_skip = ((new_t - s2.t) // tick).astype(jnp.int32)
+
+            # the whole accrual rides a scalar cond, not just the masks:
+            # non-quiescent executed ticks (most of a burst/drain phase)
+            # must not pay the mask computation (the FFD branch re-sorts
+            # the queue) plus two full queue rewrites for an identity
+            def leap(s):
+                return jax.vmap(
+                    functools.partial(_leap_local, cfg=cfg),
+                    in_axes=(_STATE_AXES, None, None),
+                    out_axes=(_STATE_AXES, 0))(s, new_t, jnp.bool_(True))
+
+            s3, rate = jax.lax.cond(
+                quiet, leap, lambda s: (s, jnp.zeros((C,), jnp.float32)), s2)
+            s3 = s3.replace(t=new_t)
+            bucket = jnp.clip(jnp.floor(jnp.log2(jnp.maximum(
+                n_skip, 1).astype(jnp.float32))).astype(jnp.int32),
+                0, st.LEAP_BUCKETS - 1)
+            stats = st.LeapStats(
+                ticks_executed=stats.ticks_executed + 1,
+                leaps=stats.leaps.at[bucket].add(
+                    (n_skip > 0).astype(jnp.int32)))
+            if record:
+                samp = st.metric_sample(s2)
+                jr = jnp.arange(n_ticks, dtype=jnp.int32)
+                skip_m = jnp.logical_and(jr > i, jr <= i + n_skip)
+                # skipped samples: jobs_in_queue replicates the fixed
+                # point; avg_wait folds the per-tick accrual rate in
+                totals = (s2.wait_total[None, :]
+                          + (jr - i).astype(jnp.float32)[:, None]
+                          * rate[None, :])
+                avg = jnp.where(s2.wait_jobs[None, :] > 0,
+                                totals / jnp.maximum(s2.wait_jobs, 1)[None, :],
+                                0.0)
+                ser = st.MetricSample(
+                    t=jnp.where(skip_m, t0 + (jr + 1) * tick,
+                                ser.t).at[i].set(samp.t),
+                    jobs_in_queue=jnp.where(
+                        skip_m[:, None], s2.jobs_in_queue[None, :],
+                        ser.jobs_in_queue).at[i].set(samp.jobs_in_queue),
+                    avg_wait_ms=jnp.where(
+                        skip_m[:, None], avg,
+                        ser.avg_wait_ms).at[i].set(samp.avg_wait_ms))
+            return (s3, stats, ser)
+
+        state, stats, series = jax.lax.while_loop(
+            cond, body, (state, stats, ser0))
+        return (state, series, stats) if record else (state, stats)
+
+    def run_compressed_jit(self, donate: bool = False):
+        """A jitted ``run_compressed`` (same donation contract as
+        ``run_jit``): (state, TickArrivals, n_ticks-static) ->
+        (state, LeapStats) or (state, MetricSample series, LeapStats)."""
+        return jax.jit(self.run_compressed, static_argnums=(2,),
                        donate_argnums=(0,) if donate else ())

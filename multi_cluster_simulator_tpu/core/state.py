@@ -182,6 +182,29 @@ def metric_sample(s: SimState) -> MetricSample:
                         avg_wait_ms=avg_wait_ms(s))
 
 
+# log2 histogram width for LeapStats.leaps: bucket b counts leaps that
+# skipped [2^b, 2^(b+1)) ticks; 32 buckets cover any int32 tick count, so
+# no leap is ever folded into the top bucket
+LEAP_BUCKETS = 32
+
+
+@struct.dataclass
+class LeapStats:
+    """Event-compression accounting for ``Engine.run_compressed``: how many
+    ticks the leap driver actually executed (vs the dense driver's one tick
+    per tick_ms of virtual time) and a log2 histogram of leap lengths. The
+    values are replicated across shards — every shard executes the same
+    ticks and takes the same leaps (the leap distance is an ``ex.allmin``)."""
+
+    ticks_executed: jax.Array  # [] i32
+    leaps: jax.Array  # [LEAP_BUCKETS] i32
+
+
+def leap_stats_init() -> LeapStats:
+    return LeapStats(ticks_executed=jnp.int32(0),
+                     leaps=jnp.zeros((LEAP_BUCKETS,), jnp.int32))
+
+
 def utilization(s: SimState) -> tuple[jax.Array, jax.Array]:
     """(core_util, mem_util) per cluster — GetResourceUtilization
     (cluster.go:46-63): used/total over active nodes."""

@@ -54,6 +54,17 @@ def trade_round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
     return jax.lax.cond(do, lambda s: _round(s, t, cfg, ex), lambda s: s, state)
 
 
+def next_cadence_t(t, mcfg) -> jax.Array:
+    """The next virtual time strictly after ``t`` at which the market can
+    act: the 5 s state-stream refresh (phase 6 snapshot) or the 10 s
+    monitor wakeup (this round gate). Between consecutive boundaries both
+    phases are data-independent no-ops, which is what lets the
+    event-compressed driver (core/engine.py run_compressed) leap straight
+    to the boundary."""
+    nxt = lambda c: (t // jnp.int32(c) + 1) * jnp.int32(c)
+    return jnp.minimum(nxt(mcfg.state_cadence_ms), nxt(mcfg.monitor_period_ms))
+
+
 def _match_greedy(state: SimState, tr, t, mcfg, ex, gidx, g_buyer, g_con):
     """The reference's negotiation, determinized (trader.go:193-278): each
     seller evaluates only its lowest-index requesting buyer (the

@@ -127,6 +127,14 @@ def start_many(rs: RunningSet, rows: jax.Array, n_take: jax.Array) -> RunningSet
     return RunningSet(data=data, active=jnp.logical_or(rs.active, written))
 
 
+def next_end_t(rs: RunningSet) -> jax.Array:
+    """Earliest completion time in the set (NEVER when empty) — the
+    min-``end_t`` probe the event-compressed driver folds into its
+    next-event time (core/engine.py _next_event_t): no release can fire
+    before the first tick whose clock reaches this value."""
+    return jnp.min(jnp.where(rs.active, rs.end_t, NEVER))
+
+
 def release(rs: RunningSet, free: jax.Array, t: jax.Array):
     """Complete all jobs with ``end_t <= t``: return their resources to
     ``free`` (RunJob's increment half, cluster.go:153-157) and clear slots.

@@ -43,6 +43,12 @@ class Exchange:
     def offset(self, c_local: int):
         raise NotImplementedError
 
+    def alland(self, x):
+        """Cross-shard logical AND of a bool — ``allmin`` over the 0/1 form.
+        The event-compressed driver uses it for the quiescence vote: every
+        shard must see a fixed point before any shard may leap."""
+        return self.allmin(x.astype(jnp.int32)) > 0
+
     def global_index(self, c_local: int):
         """Global cluster indices of this shard's local clusters."""
         return self.offset(c_local) + jnp.arange(c_local, dtype=jnp.int32)

@@ -103,7 +103,7 @@ class ShardedEngine:
         return _device_put_tree(arrivals, specs, self.mesh, place)
 
     def run_fn(self, n_ticks: int, tick_indexed: bool = False,
-               donate: bool = False):
+               donate: bool = False, time_compress: bool = False):
         """A jitted (state, arrivals) -> state advancing n_ticks under
         shard_map (``(state, MetricSample)`` when cfg.record_metrics: the
         [T, C] series stays cluster-sharded on its second axis).
@@ -111,10 +111,20 @@ class ShardedEngine:
         ``donate=True`` donates the sharded input state's buffers so the
         multi-GB constellation state is updated in place per shard instead
         of double-buffered in HBM (same contract as Engine.run_jit: the
-        caller's state arrays are invalid after the call)."""
+        caller's state arrays are invalid after the call).
+        ``time_compress=True`` (requires ``tick_indexed``) runs the
+        event-compressed driver instead of the dense scan: the per-shard
+        quiescence votes and leap targets ride the mesh exchange
+        (``alland``/``allmin``) so every shard executes the same ticks,
+        and a replicated ``LeapStats`` is appended to the outputs."""
         eng = self.engine
+        if time_compress and not tick_indexed:
+            raise ValueError("time_compress requires tick_indexed "
+                             "(pre-bucketed TickArrivals)")
 
         def body(state, arrivals):
+            if time_compress:
+                return eng.run_compressed(state, arrivals, n_ticks)
             return eng.run(state, arrivals, n_ticks)
 
         out_specs = _state_specs(self.axis)
@@ -123,6 +133,13 @@ class ShardedEngine:
             out_specs = (out_specs, MetricSample(
                 t=P(), jobs_in_queue=P(None, self.axis),
                 avg_wait_ms=P(None, self.axis)))
+        if time_compress:
+            from multi_cluster_simulator_tpu.core.state import LeapStats
+            stats_spec = LeapStats(ticks_executed=P(), leaps=P())
+            if self.cfg.record_metrics:
+                out_specs = out_specs + (stats_spec,)
+            else:
+                out_specs = (out_specs, stats_spec)
         arr_specs = (_tick_arr_specs(self.axis) if tick_indexed
                      else _arr_specs(self.axis))
         mapped = _shard_map(

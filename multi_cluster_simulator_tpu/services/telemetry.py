@@ -30,6 +30,12 @@ follows the standard ``OTEL_EXPORTER_OTLP_PROTOCOL`` selector:
 - ``http/json`` (default here) — protojson POSTs to
   ``<endpoint>/v1/{traces,metrics}`` via stdlib urllib.
 
+Any other selector (e.g. the spec's ``http/protobuf``) fails fast at
+Tracer/Meter construction when an endpoint is configured — it used to fall
+silently through to the JSON POST path. ``OTEL_EXPORTER_OTLP_INSECURE``
+(truthy) forces a plaintext gRPC channel even to an https:// endpoint, per
+the standard env contract.
+
 The JSONL paths stay the no-collector default, exactly like the reference
 run without a collector.
 """
@@ -55,6 +61,11 @@ OTLP_ENDPOINT_ENV = "OTEL_EXPORTER_OTLP_ENDPOINT"  # telemetry.go:28
 # what a gRPC-only collector on :4317 accepts); "http/json" (this
 # framework's default) posts protojson to <endpoint>/v1/{traces,metrics}.
 OTLP_PROTOCOL_ENV = "OTEL_EXPORTER_OTLP_PROTOCOL"
+# Standard OTel TLS opt-out (the spec's OTEL_EXPORTER_OTLP_INSECURE): a
+# truthy value forces a plaintext channel even to an https:// endpoint.
+OTLP_INSECURE_ENV = "OTEL_EXPORTER_OTLP_INSECURE"
+
+SUPPORTED_OTLP_PROTOCOLS = ("grpc", "http/json")
 
 
 def _otlp_endpoint() -> Optional[str]:
@@ -63,15 +74,34 @@ def _otlp_endpoint() -> Optional[str]:
 
 
 def _otlp_protocol() -> str:
-    return os.environ.get(OTLP_PROTOCOL_ENV, "http/json").strip() or "http/json"
+    return os.environ.get(OTLP_PROTOCOL_ENV, "").strip() or "http/json"
+
+
+def _check_otlp_protocol(protocol: str) -> str:
+    """Fail fast on a transport this framework does not implement: an
+    unrecognized selector (e.g. the spec's ``http/protobuf``) used to fall
+    silently through to the JSON POST path, exporting a payload a
+    protobuf-only collector rejects with no hint at the real cause."""
+    if protocol not in SUPPORTED_OTLP_PROTOCOLS:
+        raise ValueError(
+            f"unsupported OTLP protocol {protocol!r} (from {OTLP_PROTOCOL_ENV}"
+            " or otlp_protocol=): supported protocols are "
+            f"{', '.join(SUPPORTED_OTLP_PROTOCOLS)}")
+    return protocol
+
+
+def _otlp_insecure() -> bool:
+    return os.environ.get(OTLP_INSECURE_ENV, "").strip().lower() in (
+        "1", "true", "yes")
 
 
 def _make_grpc_channel(endpoint: str):
     """A long-lived channel to the collector; https:// selects TLS (a
-    plaintext channel to a TLS collector fails every handshake silently)."""
+    plaintext channel to a TLS collector fails every handshake silently)
+    unless OTEL_EXPORTER_OTLP_INSECURE opts out."""
     import grpc
 
-    secure = endpoint.startswith("https://")
+    secure = endpoint.startswith("https://") and not _otlp_insecure()
     target = endpoint
     for scheme in ("http://", "https://", "grpc://"):
         if target.startswith(scheme):
@@ -275,6 +305,8 @@ class Tracer:
         self.otlp = (otlp_endpoint if otlp_endpoint is not None
                      else _otlp_endpoint()) or None
         self.otlp_protocol = otlp_protocol or _otlp_protocol()
+        if self.otlp is not None:  # exports would actually use it
+            _check_otlp_protocol(self.otlp_protocol)
         self.flush_period_s = flush_period_s
         self._lock = threading.Lock()  # guards: _batch, _flusher, _channel
         self._batch: list[dict] = []
@@ -412,6 +444,8 @@ class Meter:
         self.otlp = (otlp_endpoint if otlp_endpoint is not None
                      else _otlp_endpoint()) or None  # "" opts out
         self.otlp_protocol = otlp_protocol or _otlp_protocol()
+        if self.otlp is not None:  # exports would actually use it
+            _check_otlp_protocol(self.otlp_protocol)
         self._counters: dict[str, float] = {}
         self._hists: dict[str, list[int]] = {}
         self._hist_sum: dict[str, float] = {}

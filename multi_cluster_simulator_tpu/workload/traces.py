@@ -77,6 +77,31 @@ def borg_like_stream(n_clusters: int, jobs_per_cluster: int, horizon_ms: int,
     return _pack(t, cores, mem, dur)
 
 
+def bursty_stream(n_clusters: int, bursts: int, jobs_per_burst: int,
+                  interval_ms: int, window_ms: int, max_cores: int,
+                  max_mem: int, max_dur_ms: int, seed: int = 0,
+                  beta: float = 2.0) -> Arrivals:
+    """Burst-sparse arrivals — the Borg-sparsity regime the
+    event-compressed driver leaps over (ARCHITECTURE.md §time
+    compression): ``bursts`` bursts per cluster, ``jobs_per_burst`` jobs
+    each, burst ``b``'s jobs landing uniformly inside
+    ``[b*interval_ms, b*interval_ms + window_ms)``. With
+    ``max_dur_ms + window_ms`` well under ``interval_ms`` the whole
+    constellation drains and idles between bursts, so the vast majority of
+    ticks are provably no-ops."""
+    # simlint: ignore[det-wallclock] -- explicitly seeded: the same seed
+    # reproduces the same stream bit-for-bit
+    rng = np.random.Generator(np.random.PCG64(seed))
+    C, A = n_clusters, bursts * jobs_per_burst
+    base = np.repeat(np.arange(bursts, dtype=np.int64) * interval_ms,
+                     jobs_per_burst)  # [A]
+    t = base[None, :] + rng.integers(0, window_ms, (C, A))
+    cores = np.floor(rng.beta(beta, beta, (C, A)) * max_cores)
+    mem = np.floor(rng.beta(beta, beta, (C, A)) * max_mem)
+    dur = rng.integers(0, max_dur_ms, (C, A))
+    return _pack(t, cores, mem, dur)
+
+
 def from_arrays(t_ms, cores, mem, dur_ms, gpus=None) -> Arrivals:
     """Replay an externally loaded trace (e.g. parsed Borg CSV) — inputs are
     [C, A] arrays; times need not be sorted."""

@@ -201,3 +201,178 @@ def test_undonated_run_jit_keeps_caller_buffers():
     eng.run_jit()(s0, ta, N_TICKS)
     assert not any(leaf.is_deleted() for leaf in jax.tree.leaves(s0))
     np.asarray(s0.placed_total)  # still readable
+
+
+def test_pack_arrivals_near_overflow_dest_is_dropped():
+    """Regression (ADVICE r5): an arrival near 2^31 must park on the
+    overflow tick, not wrap ``t + tick_ms - 1`` negative in the stream's
+    int32 dtype and bucket into tick 0."""
+    C = 1
+    t = np.asarray([[100, 2**31 - 500]], np.int32)
+    arr = Arrivals(
+        t=t, id=np.asarray([[7, 8]], np.int32),
+        cores=np.ones((C, 2), np.int32), mem=np.ones((C, 2), np.int32),
+        gpu=np.zeros((C, 2), np.int32),
+        dur=np.full((C, 2), 1_000, np.int32), n=np.full((C,), 2, np.int32))
+    ta = pack_arrivals_by_tick(arr, 10, TICK_MS)
+    counts = np.asarray(ta.counts)
+    assert counts.sum() == 1, "the beyond-horizon arrival must be dropped"
+    assert counts[0, 0] == 1 and np.asarray(ta.rows)[0, 0, 0, 0] == 7, \
+        "tick 0 must hold only the in-horizon arrival"
+
+
+# --------------------------------------------------------------------------
+# time compression (engine.run_compressed): the leap driver must be pure
+# wall-clock — bit-identical final state AND reconstructed metric series vs
+# the dense scan, across every policy family and a ragged-K chunk boundary
+# (ARCHITECTURE.md §time compression)
+# --------------------------------------------------------------------------
+
+TC_TICKS = 80
+
+
+def _tc_arrivals(t_rows, cores_rows, dur_rows, n=None):
+    t = np.asarray(t_rows, np.int32)
+    C, A = t.shape
+    return Arrivals(
+        t=t, id=np.arange(C * A, dtype=np.int32).reshape(C, A),
+        cores=np.asarray(cores_rows, np.int32),
+        mem=np.full((C, A), 500, np.int32), gpu=np.zeros((C, A), np.int32),
+        dur=np.asarray(dur_rows, np.int32),
+        n=np.full((C,), A, np.int32) if n is None else np.asarray(n, np.int32))
+
+
+def _tc_scenarios():
+    """One scenario per policy family + one per leap-event class: sparse
+    bursts with deep quiet valleys so leaps actually happen, durations
+    short enough that completions land inside the gaps."""
+    from multi_cluster_simulator_tpu.config import TraderConfig
+
+    base = dict(n_res=2, queue_capacity=16, max_running=32, max_arrivals=4,
+                max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0,
+                record_metrics=True)
+    t4 = [[2_500, 3_500, 40_000, 60_500]]
+    out = {}
+    # DELAY parity: l0-head + L1-sweep wait accrual over leaps
+    out["delay_parity"] = (
+        SimConfig(policy=PolicyKind.DELAY, parity=True, **base),
+        _tc_arrivals(t4, [[8, 2, 8, 2]], [[5_000] * 4]),
+        [uniform_cluster(1, 5)])
+    # DELAY blocked (regime B): 64-core jobs can never place on 32-core
+    # nodes -> promotion event at max_wait_ms, then closed-form per-tick
+    # wait accrual on the still-queued Level1 job across every leap
+    out["delay_blocked"] = (
+        SimConfig(policy=PolicyKind.DELAY, parity=True, **base),
+        _tc_arrivals(t4, [[64, 2, 64, 2]], [[5_000] * 4]),
+        [uniform_cluster(1, 5)])
+    # DELAY fast wave + trader market on: cadence boundaries are events
+    trader_base = dict(base, n_res=3, max_virtual_nodes=2)
+    out["delay_wave_trader"] = (
+        SimConfig(policy=PolicyKind.DELAY, parity=False, delay_sweep="wave",
+                  trader=TraderConfig(enabled=True), **trader_base),
+        _tc_arrivals(t4 * 2, [[8, 2, 8, 2]] * 2, [[5_000] * 4] * 2),
+        [uniform_cluster(1, 5), uniform_cluster(2, 5)])
+    # FFD fast: BFD-ordered sweep accrual
+    out["ffd"] = (
+        SimConfig(policy=PolicyKind.FFD, parity=False, **base),
+        _tc_arrivals(t4, [[8, 2, 8, 2]], [[5_000] * 4]),
+        [uniform_cluster(1, 5)])
+    # FIFO + borrowing: starved cluster 0 borrows from idle big cluster 1
+    out["fifo_borrowing"] = (
+        SimConfig(policy=PolicyKind.FIFO, parity=True, borrowing=True,
+                  **dict(base, max_nodes=10)),
+        _tc_arrivals([[2_500, 2_600, 2_700, 40_000], [0] * 4],
+                     [[14, 14, 14, 2], [1] * 4],
+                     [[20_000, 20_000, 20_000, 5_000], [1_000] * 4],
+                     n=[4, 0]),
+        [uniform_cluster(1, 2, cores=16, memory=8_000),
+         uniform_cluster(2, 10)])
+    return out
+
+
+@pytest.mark.parametrize("name", sorted(_tc_scenarios()))
+def test_time_compressed_bit_identical_to_dense(name):
+    """Final state AND the reconstructed per-tick metric series must equal
+    the dense scan bit for bit, while the driver provably leapt (executed
+    fewer ticks than it simulated)."""
+    cfg, arr, specs = _tc_scenarios()[name]
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    eng = Engine(cfg)
+    ref, ref_series = eng.run_jit()(init_state(cfg, specs), ta, TC_TICKS)
+    out, series, stats = eng.run_compressed_jit()(
+        init_state(cfg, specs), ta, TC_TICKS)
+    _assert_trees_equal(ref, out)
+    _assert_trees_equal(ref_series, series)
+    executed = int(np.asarray(stats.ticks_executed))
+    assert executed < TC_TICKS, "compression never leapt — vacuous test"
+    assert int(np.asarray(stats.leaps).sum()) > 0
+    assert int(np.asarray(out.placed_total).sum()) > 0
+
+
+def test_time_compressed_chunked_across_ragged_k_boundary():
+    """The leap driver composed with the full chunk pipeline — ragged
+    per-chunk K, donated state, prefetch — still equals one dense
+    global-K scan; the resumed chunk leaps from its own clock."""
+    C, T, chunks = 3, 60, [30, 30]
+    # chunk 0: sparse singles (K=1); chunk 1: a 5-deep burst at tick 40
+    # (K=8) — a ragged-K boundary with deep quiet valleys on both sides
+    t = np.asarray([[1_500, 2_500, 3_500,
+                     40_200, 40_300, 40_350, 40_400, 40_450]] * C, np.int32)
+    A = t.shape[1]
+    rng = np.random.RandomState(7)
+    arr = Arrivals(
+        t=t, id=np.arange(C * A, dtype=np.int32).reshape(C, A),
+        cores=rng.randint(1, 4, size=(C, A)).astype(np.int32),
+        mem=rng.randint(100, 2_000, size=(C, A)).astype(np.int32),
+        gpu=np.zeros((C, A), np.int32),
+        dur=rng.randint(1_000, 5_000, size=(C, A)).astype(np.int32),
+        n=np.full((C,), A, np.int32))
+    cfg = _cfg()
+    eng = Engine(cfg)
+    ta = pack_arrivals_by_tick(arr, T, TICK_MS)
+    ref = eng.run_jit()(init_state(cfg, _specs(C)), ta, T)
+
+    parts = pack_arrivals_chunks(arr, chunks, TICK_MS)
+    assert parts[0].rows.shape[2] != parts[1].rows.shape[2]
+    jfn = eng.run_compressed_jit(donate=True)
+    s = jax.tree.map(jnp.copy, init_state(cfg, _specs(C)))
+    executed = 0
+    nxt = jax.device_put(parts[0])
+    for i, n in enumerate(chunks):
+        a = nxt
+        s, stats = jfn(s, a, n)
+        if i + 1 < len(parts):
+            nxt = jax.device_put(parts[i + 1])
+        executed += int(np.asarray(stats.ticks_executed))
+    s = jax.block_until_ready(s)
+    _assert_trees_equal(ref, s)
+    assert executed < T
+
+
+@pytest.mark.parametrize("n_ticks", [5, 6, 7])
+def test_time_compressed_run_ending_on_busy_tick(n_ticks):
+    """Regression: a horizon that ends on a NON-quiescent tick (a placement
+    rotates a successor with stale FREC into the processed set) must still
+    match the dense driver bit for bit — the closed-form accrual has to be
+    gated on the quiescence vote, not just on the leap distance, or the
+    final tick accrues wait the dense pass only records a tick later."""
+    from multi_cluster_simulator_tpu.config import SimConfig as SC
+
+    cfg = SC(policy=PolicyKind.DELAY, parity=True, n_res=2,
+             queue_capacity=16, max_running=32, max_arrivals=6,
+             max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0)
+    arr = _tc_arrivals([[500, 600, 700, 800, 900, 1_000]],
+                       [[8, 8, 8, 8, 2, 2]], [[30_000] * 6])
+    specs = [uniform_cluster(1, 5)]
+    ta = pack_arrivals_by_tick(arr, n_ticks, cfg.tick_ms)
+    eng = Engine(cfg)
+    ref = eng.run_jit()(init_state(cfg, specs), ta, n_ticks)
+    out, _ = eng.run_compressed_jit()(init_state(cfg, specs), ta, n_ticks)
+    _assert_trees_equal(ref, out)
+
+
+def test_time_compress_requires_tick_arrivals():
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="TickArrivals"):
+        Engine(cfg).run_compressed(init_state(cfg, _specs(1)),
+                                   _bursty_arrivals(1), N_TICKS)

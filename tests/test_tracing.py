@@ -373,3 +373,56 @@ def test_otlp_grpc_export():
         m.stop_exporter()
     finally:
         col.stop()
+
+
+def test_unsupported_otlp_protocol_fails_fast(monkeypatch):
+    """ADVICE r5: an unrecognized OTEL_EXPORTER_OTLP_PROTOCOL (e.g. the
+    spec's http/protobuf) used to fall silently through to the JSON POST
+    path; with an endpoint configured it must fail at construction,
+    naming the supported set."""
+    import pytest
+
+    from multi_cluster_simulator_tpu.services.telemetry import Meter
+
+    with pytest.raises(ValueError, match="grpc, http/json"):
+        Tracer("svc", otlp_endpoint="http://collector:4318",
+               otlp_protocol="http/protobuf")
+    with pytest.raises(ValueError, match="http/protobuf"):
+        Meter("svc", otlp_endpoint="http://collector:4318",
+              otlp_protocol="http/protobuf")
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", "http://collector:4318")
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_PROTOCOL", "http/protobuf")
+    with pytest.raises(ValueError, match="unsupported OTLP protocol"):
+        Tracer("svc")
+    with pytest.raises(ValueError, match="unsupported OTLP protocol"):
+        Meter("svc")
+    # with no endpoint nothing would export — a stale selector must not
+    # break collector-less runs (the no-collector default)
+    monkeypatch.delenv("OTEL_EXPORTER_OTLP_ENDPOINT")
+    Tracer("svc")
+    Meter("svc")
+
+
+def test_otlp_insecure_env_selects_plaintext_channel(monkeypatch):
+    """OTEL_EXPORTER_OTLP_INSECURE (standard env contract): truthy forces a
+    plaintext gRPC channel even to an https:// endpoint."""
+    import pytest
+
+    grpc = pytest.importorskip("grpc")
+    from multi_cluster_simulator_tpu.services.telemetry import (
+        _make_grpc_channel,
+    )
+
+    calls = []
+    monkeypatch.setattr(grpc, "secure_channel",
+                        lambda t, creds: calls.append(("secure", t)))
+    monkeypatch.setattr(grpc, "insecure_channel",
+                        lambda t: calls.append(("insecure", t)))
+    _make_grpc_channel("https://collector:4317")
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_INSECURE", "true")
+    _make_grpc_channel("https://collector:4317")
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_INSECURE", "false")
+    _make_grpc_channel("https://collector:4317")
+    assert calls == [("secure", "collector:4317"),
+                     ("insecure", "collector:4317"),
+                     ("secure", "collector:4317")]
