@@ -1,0 +1,240 @@
+"""Range-audited narrow storage for the bandwidth-bound SimState leaves.
+
+The headline tick is memory-bound (tools/cost_probe.json: ~0.1 flops/byte),
+so after the streamed input pipeline (PR 3) and event-compressed time (PR 4)
+the next multiplier is shrinking the bytes each EXECUTED tick must touch.
+Two moves, both bit-identical to the wide layout (ARCHITECTURE.md §state
+layout):
+
+1. **SoA splits** — ``JobQueue.data[Q, NF]`` and ``RunningSet.data[S, RF]``
+   become per-field leaves (ops/queues.SoAJobQueue, ops/runset.SoARunningSet)
+   so XLA streams only the fields a phase actually reads: a read of
+   ``enq_t`` no longer pays for the other seven columns of an AoS row.
+
+2. **Range-audited storage dtypes** — this module derives per-field storage
+   widths from ``SimConfig`` + the stream's measured maxima
+   (``derive_plan``): i8/i16 where the config provably bounds the range
+   (resource demands, node indices, owner cluster indices), i32 kept for
+   ids/timestamps/durations that can exceed 2^15 (ops/fields.NARROWABLE).
+
+All ARITHMETIC stays int32: leaves are widened on load
+(``fields.widen``) and narrowed on store through the checked helper
+(``fields.narrow_store``), which clamps + counts out-of-range values into
+the layout's ``ovf`` counter instead of silently wrapping — the same
+surface-don't-swallow contract as ``Drops`` (core/state.py). Parity and
+bench runs assert the counter stays zero (utils/trace.total_drops reports
+it as ``narrow``), so storage width is invisible to replay (PARITY.md).
+
+The plan is STATIC (a frozen, hashable dataclass of dtype names): it is
+fixed at ``init_state`` from the audit, baked into the pytree's leaf
+dtypes, and never consulted at trace time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.core.spec import ClusterSpec, capacities_array
+from multi_cluster_simulator_tpu.ops import fields as F
+
+# the public home of the store primitives (defined in ops/fields.py to keep
+# the ops -> core import chain acyclic)
+narrow_store = F.narrow_store
+widen = F.widen
+
+_CANDIDATES = (np.dtype(np.int8), np.dtype(np.int16), np.dtype(np.int32))
+
+
+def fit_dtype(lo: int, hi: int) -> str:
+    """Smallest signed integer dtype whose range covers [lo, hi]."""
+    for dt in _CANDIDATES:
+        info = np.iinfo(dt)
+        if info.min <= lo and hi <= info.max:
+            return dt.name
+    raise ValueError(f"range [{lo}, {hi}] exceeds int32")
+
+
+@dataclasses.dataclass(frozen=True)
+class CompactPlan:
+    """Per-field storage dtypes for the SoA layouts — (field, dtype-name)
+    pairs per row kind, hashable so a plan can ride config closures and
+    function caches. ``None``-plan call sites keep the wide AoS layout."""
+
+    queue: tuple  # (("id", "int32"), ("cores", "int8"), ...)
+    run: tuple
+    # node_cap/node_free storage dtype: one dtype for the whole resource
+    # axis (it mixes cores/mem/gpu, so the widest bound — mem — decides;
+    # under the trader it must also hold buyer virtual-node CONTRACT
+    # totals — see derive_plan). The tick widens these once at entry and
+    # CHECKED-narrows once at exit (Engine._tick, count into run.ovf): a
+    # derivation gap here must surface as a counted overflow, never a
+    # wrapped capacity — tests/test_compact.py
+    # test_node_exit_narrow_counts_instead_of_wrapping pins it.
+    node: str = "int32"
+
+    def queue_dtypes(self) -> dict:
+        return {name: np.dtype(dt) for name, dt in self.queue}
+
+    def run_dtypes(self) -> dict:
+        return {name: np.dtype(dt) for name, dt in self.run}
+
+    def node_dtype(self) -> np.dtype:
+        return np.dtype(self.node)
+
+    def describe(self) -> dict:
+        """Detail-dict / docs form: only the fields narrower than i32."""
+        out = {
+            "queue": {n: dt for n, dt in self.queue if dt != "int32"},
+            "run": {n: dt for n, dt in self.run if dt != "int32"},
+        }
+        if self.node != "int32":
+            out["node"] = self.node
+        return out
+
+
+def audit_arrivals(arrivals) -> dict:
+    """Measured per-field maxima over the valid prefix of an ``Arrivals``
+    stream — the data half of the range audit (the config half is node
+    capacities + cluster/node counts). Host-side numpy, once per run."""
+    n = np.asarray(arrivals.n)
+    valid = np.arange(np.asarray(arrivals.t).shape[1])[None, :] < n[:, None]
+
+    def mx(a):
+        a = np.asarray(a)
+        return int(a[valid].max(initial=0))
+
+    return {"cores": mx(arrivals.cores), "mem": mx(arrivals.mem),
+            "gpu": mx(arrivals.gpu), "id": mx(arrivals.id)}
+
+
+def derive_plan(cfg: SimConfig, specs: Sequence[ClusterSpec],
+                arrivals=None) -> CompactPlan:
+    """Derive storage widths from the config + (optionally) the stream.
+
+    Bounds are conservative over everything the engine can ever store in a
+    row, not just what a phase is expected to write:
+
+    - ``cores``/``mem``/``gpu``: max of the stream's demands and the node
+      capacities — queue rows hold job demands; running-set rows also hold
+      carved virtual-node placeholders whose amounts are bounded by a
+      node's capacity (market/trader.py seller_apply).
+    - ``owner``: [-2, C-1] — a borrower's global cluster index, OWN (-1),
+      or FOREIGN (-2).
+    - ``node``: [-1, total_nodes-1] — a placement target or NO_NODE.
+    - ``id``: [-3, measured stream max] — PLACEHOLDER_ID (-3), INVALID (-1),
+      or a stream id; only narrowed when a stream audit is available
+      (nothing in the config bounds ids).
+
+    Without ``arrivals`` the demand bound falls back to node capacities
+    alone (a demand can exceed capacity and still legally sit in a queue
+    forever); the checked-store counter remains the backstop either way —
+    an out-of-range value is counted and clamped, never wrapped.
+    """
+    caps = capacities_array(specs, cfg.max_nodes)[..., : cfg.n_res]
+    cap_max = [int(caps[..., r].max(initial=0)) for r in range(cfg.n_res)]
+    while len(cap_max) < 3:
+        cap_max.append(0)
+    demand_hi = dict(zip(("cores", "mem", "gpu"), cap_max))
+    id_hi = np.iinfo(F.WIDE_DTYPE).max  # unbounded without a stream audit
+    if arrivals is not None:
+        audited = audit_arrivals(arrivals)
+        for k in ("cores", "mem", "gpu"):
+            demand_hi[k] = max(demand_hi[k], audited[k])
+        id_hi = audited["id"]
+    bounds = {
+        "id": (-3, id_hi),
+        "cores": (0, demand_hi["cores"]),
+        "mem": (0, demand_hi["mem"]),
+        "gpu": (0, demand_hi["gpu"]),
+        "owner": (-2, max(len(specs) - 1, 0)),
+        "node": (-1, cfg.total_nodes - 1),
+    }
+
+    def row_plan(names):
+        out = []
+        for name in names:
+            if name in F.NARROWABLE:
+                lo, hi = bounds[name]
+                out.append((name, fit_dtype(lo, hi)))
+            else:
+                out.append((name, F.WIDE_DTYPE.name))
+        return tuple(out)
+
+    # Node tensors hold capacities and free amounts. Without the trader,
+    # both are bounded by the largest per-node physical capacity. WITH the
+    # trader, a buyer's virtual node echoes the CONTRACT's totals
+    # (market/trader.py buyer_apply; trader_server.go:58), and a contract
+    # is sized as a cumsum over the Level1 backlog (ops/sizing.py) — up to
+    # queue_capacity jobs of audited demand, which can dwarf any single
+    # physical node. The seller side stays per-node bounded (carve amounts
+    # never exceed a node's free), but the buyer tensor must hold the
+    # total, so the bound scales with the backlog.
+    node_hi = max(cap_max) if cap_max else 0
+    if cfg.trader.enabled:
+        node_hi = max(node_hi,
+                      cfg.queue_capacity * max(demand_hi.values()))
+    return CompactPlan(queue=row_plan(F.QUEUE_FIELDS),
+                       run=row_plan(F.RUN_FIELDS),
+                       node=fit_dtype(0, min(node_hi, 2**31 - 1)))
+
+
+def wide_plan() -> CompactPlan:
+    """An all-int32 plan: the SoA layout without any narrowing — used by
+    tests to separate the layout move from the dtype move."""
+    i32 = F.WIDE_DTYPE.name
+    return CompactPlan(queue=tuple((n, i32) for n in F.QUEUE_FIELDS),
+                       run=tuple((n, i32) for n in F.RUN_FIELDS))
+
+
+# --------------------------------------------------------------------------
+# canonicalization + accounting helpers
+# --------------------------------------------------------------------------
+
+
+def to_wide(state):
+    """Convert a compact SimState back to the wide AoS layout (host-side or
+    traced) — the canonical form for compact-vs-wide bit-equality checks
+    and for checkpoints that must round-trip across layouts. Overflow
+    counters are dropped (assert them zero separately: they have no wide
+    ancestor). A wide state passes through unchanged."""
+    from multi_cluster_simulator_tpu.ops import queues as Q
+    from multi_cluster_simulator_tpu.ops import runset as R
+
+    import jax.numpy as jnp
+
+    kw = {}
+    for qn in ("l0", "l1", "ready", "wait", "lent", "borrowed"):
+        q = getattr(state, qn)
+        if not isinstance(q, Q.JobQueue):
+            kw[qn] = Q.soa_to_wide(q)
+    if not isinstance(state.run, R.RunningSet):
+        kw["run"] = R.soa_to_wide(state.run)
+    if state.node_free.dtype != jnp.int32:
+        kw["node_free"] = F.widen(state.node_free)
+        kw["node_cap"] = F.widen(state.node_cap)
+    return state.replace(**kw) if kw else state
+
+
+def overflow_total(state) -> int:
+    """Host-side sum of every narrow-store overflow counter in a SimState
+    (0 for wide states) — the ``narrow`` entry of utils/trace.total_drops."""
+    total = 0
+    for qn in ("l0", "l1", "ready", "wait", "lent", "borrowed", "run"):
+        ovf = getattr(getattr(state, qn), "ovf", None)
+        if ovf is not None:
+            total += int(np.asarray(ovf).sum())
+    return total
+
+
+def state_nbytes(state) -> int:
+    """Total byte footprint of a SimState's leaves — the ``state_bytes``
+    bench detail: the honest, backend-independent half of the bytes win
+    (``tick_bytes_accessed`` is the compiler-measured half)."""
+    import jax
+
+    return int(sum(np.asarray(leaf).nbytes if not hasattr(leaf, "nbytes")
+                   else leaf.nbytes for leaf in jax.tree.leaves(state)))

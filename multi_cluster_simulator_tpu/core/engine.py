@@ -49,6 +49,7 @@ from flax import struct
 from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
 from multi_cluster_simulator_tpu.core import state as st
 from multi_cluster_simulator_tpu.core.state import Arrivals, SimState, Trace
+from multi_cluster_simulator_tpu.ops import fields as F
 from multi_cluster_simulator_tpu.ops import placement as P
 from multi_cluster_simulator_tpu.ops import queues as Q
 from multi_cluster_simulator_tpu.ops import runset as R
@@ -175,6 +176,13 @@ def _quiescence_sig(state: SimState) -> jax.Array:
     t-dependence is wait recording plus the promotion threshold, which the
     next-event probe handles (``_next_event_t``)."""
     d = state.drops
+    # compact layouts carry narrow-store overflow counters; fold them into
+    # the drop sum so an overflow tick can never be judged quiescent
+    ovf = jnp.int32(0)
+    for part in (state.l0, state.l1, state.ready, state.wait, state.lent,
+                 state.borrowed, state.run):
+        if hasattr(part, "ovf"):
+            ovf = ovf + jnp.sum(part.ovf)
     parts = [
         jnp.sum(state.placed_total), jnp.sum(state.arr_ptr),
         jnp.sum(state.run.active.astype(jnp.int32)),
@@ -183,7 +191,7 @@ def _quiescence_sig(state: SimState) -> jax.Array:
         jnp.sum(state.lent.count), jnp.sum(state.borrowed.count),
         jnp.sum(state.node_active.astype(jnp.int32)),
         jnp.sum(d.queue) + jnp.sum(d.msgs) + jnp.sum(d.run_full)
-        + jnp.sum(d.vslot) + jnp.sum(d.carve) + jnp.sum(d.ingest),
+        + jnp.sum(d.vslot) + jnp.sum(d.carve) + jnp.sum(d.ingest) + ovf,
     ]
     return jnp.stack([p.astype(jnp.int32) for p in parts])
 
@@ -204,7 +212,7 @@ def _next_event_t(state: SimState, t, cfg: SimConfig) -> jax.Array:
     Values are raw event times; the driver rounds up to the tick grid."""
     ev = jnp.min(jax.vmap(R.next_end_t)(state.run))
     if cfg.policy == PolicyKind.DELAY:
-        head_enq = state.l0.data[:, 0, Q.FENQ]  # [C]
+        head_enq = state.l0.enq_t[:, 0]  # [C]
         promote = jnp.where(state.l0.count > 0,
                             head_enq + jnp.int32(cfg.max_wait_ms), R.NEVER)
         ev = jnp.minimum(ev, jnp.min(promote))
@@ -273,10 +281,10 @@ def _leap_local(s: SimState, new_t, do, cfg: SimConfig):
     l1_mask = jnp.logical_and(l1_mask, do)
 
     def accrue(q, mask, total):
-        cur = (new_t - q.data[:, Q.FENQ]).astype(jnp.int32)
-        frec = q.data[:, Q.FREC]
+        cur = (new_t - q.enq_t).astype(jnp.int32)
+        frec = q.rec_wait
         delta = jnp.where(mask, (cur - frec).astype(jnp.float32), 0.0)
-        q = Q.set_col(q, Q.FREC, jnp.where(mask, cur, frec))
+        q = Q.set_field(q, "rec_wait", jnp.where(mask, cur, frec))
         return q, total + delta.sum()
 
     # dense tick order: the Level1 sweep accrues before the Level0 head
@@ -317,7 +325,7 @@ def _pack_returns(run, done, M: int):
     is_ret = jnp.logical_and(done, run.owner >= 0)  # [C_loc, S]
     order = jnp.argsort(jnp.logical_not(is_ret), axis=1, stable=True)[:, :M]
     take = jnp.take_along_axis(is_ret, order, axis=1)  # [C_loc, M]
-    rows = jnp.take_along_axis(run.data, order[..., None], axis=1)  # [C_loc, M, RF]
+    rows = R.gather_rows_along(run, order)  # [C_loc, M, RF] i32
     dropped = jnp.sum(is_ret, axis=1) - jnp.sum(take, axis=1)  # beyond M
     return rows, take, dropped.astype(jnp.int32)
 
@@ -369,11 +377,15 @@ def pack_arrivals(arr: Arrivals) -> tuple[jax.Array, jax.Array]:
     Done once per run (outside the tick scan): the per-tick ingest then
     extracts its window with a one-hot contraction instead of six batched
     gathers — TPU gathers serialize and were the single largest per-tick
-    cost at 4k clusters."""
+    cost at 4k clusters. Column order comes from the canonical field schema
+    (ops/fields.py), the same one site the queue layouts derive theirs
+    from."""
     own = jnp.full(arr.t.shape, Q.OWN, jnp.int32)
     zero = jnp.zeros(arr.t.shape, jnp.int32)
-    rows = jnp.stack([arr.id, arr.cores, arr.mem, arr.gpu, arr.dur, arr.t,
-                      own, zero], axis=-1).astype(jnp.int32)
+    vals = {"id": arr.id, "cores": arr.cores, "mem": arr.mem, "gpu": arr.gpu,
+            "dur": arr.dur, "enq_t": arr.t, "owner": own, "rec_wait": zero}
+    rows = jnp.stack([vals[n] for n in F.QUEUE_FIELDS],
+                     axis=-1).astype(jnp.int32)
     return rows, arr.n
 
 
@@ -409,11 +421,12 @@ def _bucket_arrivals_host(arr: Arrivals, n_ticks: int, tick_ms: int):
     firsts = np.zeros((C, n_ticks + 1), np.int64)
     firsts[:, 1:] = np.cumsum(counts2d, axis=1)[:, :-1]
     rank = np.arange(A)[None, :] - firsts[np.arange(C)[:, None], dest]
-    fields = np.stack([np.asarray(arr.id), np.asarray(arr.cores),
-                       np.asarray(arr.mem), np.asarray(arr.gpu),
-                       np.asarray(arr.dur), t,
-                       np.full_like(t, int(Q.OWN)),
-                       np.zeros_like(t)], axis=-1)  # [C, A, NF]
+    vals = {"id": np.asarray(arr.id), "cores": np.asarray(arr.cores),
+            "mem": np.asarray(arr.mem), "gpu": np.asarray(arr.gpu),
+            "dur": np.asarray(arr.dur), "enq_t": t,
+            "owner": np.full_like(t, int(Q.OWN)),
+            "rec_wait": np.zeros_like(t)}
+    fields = np.stack([vals[n] for n in F.QUEUE_FIELDS], axis=-1)  # [C, A, NF]
     return fields, dest, ok, rank, counts2d.T[:n_ticks].copy()
 
 
@@ -599,9 +612,8 @@ def _delay_local(s: SimState, t, cfg: SimConfig):
         # one-hot slot access: dynamic row gathers/scatters serialize when
         # the loop body is vmapped over thousands of clusters
         hot = jnp.arange(s2.l1.capacity, dtype=jnp.int32) == i
-        row = jnp.einsum("q,qf->f", hot.astype(jnp.int32), s2.l1.data)
         rec_i = jnp.einsum("q,q->", hot.astype(jnp.int32), rec)
-        job = Q.JobRec(vec=row).with_(rec_wait=rec_i)
+        job = Q.select_row(s2.l1, hot).with_(rec_wait=rec_i)
         total, new_rec = _record_wait(s2.wait_total, rec_i, job.enq_t, t, process)
         rec = jnp.where(jnp.logical_and(hot, process), new_rec, rec)
         s2 = s2.replace(wait_total=total)
@@ -626,7 +638,7 @@ def _delay_local(s: SimState, t, cfg: SimConfig):
     # out_axes=None spec. Restoring the pre-loop leaf is a semantic no-op
     # that keeps t replicated on every jax version.
     s = s.replace(t=t_in)
-    l1 = Q.compact(Q.set_col(s.l1, Q.FREC, rec), jnp.logical_not(placed))
+    l1 = Q.compact(Q.set_field(s.l1, "rec_wait", rec), jnp.logical_not(placed))
     s = s.replace(l1=l1, run=R.start_many(s.run, buf, cnt))
     return _delay_l0_head(s, t, cfg)
 
@@ -638,7 +650,7 @@ def _delay_l0_head(s: SimState, t, cfg: SimConfig):
     process = s.l0.count > 0
     job = Q.head(s.l0)
     total, new_rec = _record_wait(s.wait_total, job.rec_wait, job.enq_t, t, process)
-    l0 = s.l0.replace(data=s.l0.data.at[0, Q.FREC].set(new_rec))
+    l0 = Q.set_field_elem(s.l0, "rec_wait", 0, new_rec)
     s = s.replace(wait_total=total, l0=l0)
     job = job.with_(rec_wait=new_rec)
     s, success = _attempt(s, job, t, process, st.SRC_L0, cfg.record_trace)
@@ -666,17 +678,17 @@ def _delay_wave_local(s: SimState, t, cfg: SimConfig):
     n_sweep = jnp.minimum(s.l1.count, QC)
     n_active = jnp.sum(s.run.active).astype(jnp.int32)
     act0 = jnp.arange(QC, dtype=jnp.int32) < n_sweep
-    rows = s.l1.data[:QC]  # sweep order == queue order (no sort)
+    rows = Q.rows_prefix(s.l1, QC)  # sweep order == queue order (no sort)
     jobs = Q.JobRec(vec=rows)
 
     # wait accounting, vectorized over the processed prefix (fast mode:
     # no serial-float-order constraint)
     processed_slot = s.l1.slot_valid() & (
         jnp.arange(s.l1.capacity, dtype=jnp.int32) < n_sweep)
-    cur = (t - s.l1.data[:, Q.FENQ]).astype(jnp.int32)
-    frec = s.l1.data[:, Q.FREC]
+    cur = (t - s.l1.enq_t).astype(jnp.int32)
+    frec = s.l1.rec_wait
     delta = jnp.where(processed_slot, (cur - frec).astype(jnp.float32), 0.0)
-    l1 = Q.set_col(s.l1, Q.FREC, jnp.where(processed_slot, cur, frec))
+    l1 = Q.set_field(s.l1, "rec_wait", jnp.where(processed_slot, cur, frec))
     s = s.replace(wait_total=s.wait_total + delta.sum(), l1=l1)
 
     free, node_sel, cnt, run_full = _wave_place(
@@ -728,13 +740,12 @@ def _ffd_local(s: SimState, t, cfg: SimConfig):
         hot_k = jnp.arange(cap, dtype=jnp.int32) == k
         i = jnp.einsum("q,q->", hot_k.astype(jnp.int32), order)
         hot = jnp.arange(cap, dtype=jnp.int32) == i
-        row = jnp.einsum("q,qf->f", hot.astype(jnp.int32), s2.l0.data)
-        job = Q.JobRec(vec=row)
+        job = Q.select_row(s2.l0, hot)
         total, new_rec = _record_wait(s2.wait_total, job.rec_wait, job.enq_t, t, process)
-        frec = s2.l0.data[:, Q.FREC]
+        frec = s2.l0.rec_wait
         frec = jnp.where(jnp.logical_and(hot, process), new_rec, frec)
         s2 = s2.replace(wait_total=total,
-                        l0=s2.l0.replace(data=s2.l0.data.at[:, Q.FREC].set(frec)))
+                        l0=Q.set_field(s2.l0, "rec_wait", frec))
         s2, success, buf, cnt = _attempt_deferred(
             s2, job, t, process, st.SRC_L0, cfg.record_trace, buf, cnt, n_active)
         s2 = s2.replace(jobs_in_queue=s2.jobs_in_queue - success.astype(jnp.int32))
@@ -915,17 +926,17 @@ def _ffd_wave_local(s: SimState, t, cfg: SimConfig):
     # ordered job rows: one [QC, Q] @ [Q, NF] integer contraction
     sel = (order[:, None] ==
            jnp.arange(cap_q, dtype=jnp.int32)[None, :]).astype(jnp.int32)
-    rows = jnp.einsum("kq,qf->kf", sel, s.l0.data)
+    rows = Q.gather_rows(s.l0, sel)
     jobs = Q.JobRec(vec=rows)
 
     # wait accounting, vectorized at the slot level (every processed job is
     # recorded exactly once per tick; fast mode has no serial-float-order
     # constraint — parity mode keeps the serial sweep)
     processed_slot = jnp.einsum("kq,k->q", sel, act0.astype(jnp.int32)) > 0
-    cur = (t - s.l0.data[:, Q.FENQ]).astype(jnp.int32)
-    frec = s.l0.data[:, Q.FREC]
+    cur = (t - s.l0.enq_t).astype(jnp.int32)
+    frec = s.l0.rec_wait
     delta = jnp.where(processed_slot, (cur - frec).astype(jnp.float32), 0.0)
-    l0 = Q.set_col(s.l0, Q.FREC, jnp.where(processed_slot, cur, frec))
+    l0 = Q.set_field(s.l0, "rec_wait", jnp.where(processed_slot, cur, frec))
     s = s.replace(wait_total=s.wait_total + delta.sum(), l0=l0)
 
     free, node_sel, cnt, run_full = _wave_place(
@@ -977,7 +988,7 @@ def _fifo_drain_wave(s: SimState, t, cfg: SimConfig, wait_active, n_active,
                         jnp.minimum(ready.count, QC)).astype(jnp.int32)
     pos = jnp.arange(QC, dtype=jnp.int32)
     act0 = pos < n_sweep
-    rows = ready.data[:QC]  # queue order: position == slot
+    rows = Q.rows_prefix(ready, QC)  # queue order: position == slot
     jobs = Q.JobRec(vec=rows)
 
     def cond(carry):
@@ -1077,8 +1088,7 @@ def _fifo_local(s: SimState, t, cfg: SimConfig):
             jnp.logical_and(i < jnp.minimum(s2.ready.count, QC),
                             jnp.logical_not(stopped)))
         hot = jnp.arange(s2.ready.capacity, dtype=jnp.int32) == i
-        job = Q.JobRec(vec=jnp.einsum("q,qf->f", hot.astype(jnp.int32),
-                                      s2.ready.data))
+        job = Q.select_row(s2.ready, hot)
         s2, success, buf, cnt = _attempt_deferred(
             s2, job, t, process, st.SRC_READY, cfg.record_trace, buf, cnt,
             n_active)
@@ -1263,6 +1273,19 @@ class Engine:
         cfg = self.cfg
         t = state.t + cfg.tick_ms
 
+        # compact node storage: widen ONCE at tick entry so every phase
+        # (placement compares, occupy/release arithmetic, market carves)
+        # computes in int32 exactly as the wide layout does; the exit
+        # narrow below restores the storage dtype. checked=False by the
+        # conservation invariant: free stays in [0, cap] (utils/trace.
+        # check_conservation) and cap is bounded by the plan's audit —
+        # nothing fresh enters the system here.
+        node_dt = state.node_free.dtype
+        node_narrow = node_dt != jnp.int32
+        if node_narrow:
+            state = state.replace(node_free=F.widen(state.node_free),
+                                  node_cap=F.widen(state.node_cap))
+
         # 1. completions (+ returns of finished foreign jobs)
         run_before = state.run
         st2, done = jax.vmap(_release_local, in_axes=(_STATE_AXES, None),
@@ -1327,6 +1350,23 @@ class Engine:
         # 7. trader market round
         if self._trade_round is not None:
             state = self._trade_round(state, t)
+
+        if node_narrow:
+            # CHECKED, unlike the interior permutation narrows: the plan's
+            # node bound is derived (physical caps, plus contract totals
+            # under the trader — a buyer's virtual node holds a backlog
+            # cumsum, not a per-node amount), and a derivation gap here
+            # must surface as a counted overflow, never a wrapped
+            # capacity. The count lands in the running set's ovf (the
+            # node tensors have no counter of their own); it is a scalar
+            # total folded into every cluster's counter — the parity and
+            # bench gates assert ==0, so magnitude only matters as
+            # nonzero-ness.
+            free_n, bad_f = F.narrow_store(state.node_free, node_dt)
+            cap_n, bad_c = F.narrow_store(state.node_cap, node_dt)
+            state = state.replace(
+                node_free=free_n, node_cap=cap_n,
+                run=state.run.replace(ovf=state.run.ovf + bad_f + bad_c))
 
         io = TickIO(borrow_want=want, borrow_job=bjob_vec,
                     ret_rows=ret_rows, ret_valid=ret_valid) if emit_io else None

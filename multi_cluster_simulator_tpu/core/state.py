@@ -228,20 +228,39 @@ def snapshot_utilization(s: SimState) -> tuple[jax.Array, jax.Array]:
     return cu, mu
 
 
-def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec]) -> SimState:
-    """Build the initial batched state from cluster specs."""
+def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec],
+               plan=None) -> SimState:
+    """Build the initial batched state from cluster specs.
+
+    ``plan`` is an optional ``core.compact.CompactPlan``: when given, the
+    six job queues and the running set are built in the compact SoA layout
+    with the plan's range-audited storage dtypes (bit-identical results;
+    ARCHITECTURE.md §state layout). ``None`` keeps the wide int32 AoS
+    layout."""
     C = len(specs)
     N = cfg.total_nodes
     cap_phys = capacities_array(specs, cfg.max_nodes)  # [C, max_nodes, RES]
     if cfg.n_res < RES and cap_phys[..., cfg.n_res:].any():
         raise ValueError(
             f"specs declare gpu capacity but n_res={cfg.n_res} drops the axis")
-    cap = np.zeros((C, N, cfg.n_res), dtype=np.int32)
-    cap[:, : cfg.max_nodes] = cap_phys[..., : cfg.n_res]
+    node_dt = np.int32 if plan is None else plan.node_dtype()
+    phys = cap_phys[..., : cfg.n_res]
+    if phys.size and int(phys.max()) > np.iinfo(node_dt).max:
+        raise ValueError(
+            f"compact plan's node dtype {np.dtype(node_dt).name} cannot hold "
+            f"capacity {int(phys.max())} — derive the plan from these specs")
+    cap = np.zeros((C, N, cfg.n_res), dtype=node_dt)
+    cap[:, : cfg.max_nodes] = phys
     active = (cap.sum(-1) > 0)
 
+    def batch(tree):
+        return jax.tree.map(lambda a: jnp.broadcast_to(a, (C,) + a.shape),
+                            tree)
+
     def batched_queue():
-        return jax.tree.map(lambda a: jnp.broadcast_to(a, (C,) + a.shape), Q.empty(cfg.queue_capacity))
+        q = (Q.empty(cfg.queue_capacity) if plan is None
+             else Q.empty_soa(cfg.queue_capacity, plan.queue_dtypes()))
+        return batch(q)
 
     zf = jnp.zeros((C,), jnp.float32)
     zi = jnp.zeros((C,), jnp.int32)
@@ -261,7 +280,8 @@ def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec]) -> SimState:
         wait=batched_queue(),
         lent=batched_queue(),
         borrowed=batched_queue(),
-        run=jax.tree.map(lambda a: jnp.broadcast_to(a, (C,) + a.shape), R.empty(cfg.max_running)),
+        run=batch(R.empty(cfg.max_running) if plan is None
+                  else R.empty_soa(cfg.max_running, plan.run_dtypes())),
         arr_ptr=zi,
         wait_total=zf,
         wait_jobs=zi,

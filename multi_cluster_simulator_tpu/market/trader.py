@@ -325,8 +325,7 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
                              ccon.time_ms, t)
             hot = jnp.logical_and(
                 jnp.arange(rn.capacity, dtype=jnp.int32) == slot, ok)
-            rn = R.RunningSet(data=jnp.where(hot[:, None], row, rn.data),
-                              active=jnp.logical_or(rn.active, hot))
+            rn = R.insert_row(rn, hot, row)
             nhot = jnp.logical_and(
                 jnp.arange(fr.shape[0], dtype=jnp.int32) == n, ok)
             fr = fr - nhot[:, None] * amts[n]

@@ -1,0 +1,153 @@
+"""Canonical packed-row field schemas + narrow-storage store primitives.
+
+One table per row kind — the queue row (ops/queues.py) and the running-set
+row (ops/runset.py) — defining field NAMES, ORDER, and INVALID sentinels in
+exactly one place. The wide AoS layouts (``data[Q, NF]``), the SoA compact
+layouts (per-field leaves), the engine's arrival pack paths, and the
+storage-width planner (core/compact.py) all derive their indices from these
+tuples, so adding a ninth job field is a one-site change instead of the
+previous four parallel index derivations (queues row ctor, engine
+pack_arrivals, _bucket_arrivals_host, runset row ctor).
+
+The store primitives at the bottom are the ONLY sanctioned way to move
+int32 compute values into a narrower storage leaf: ``narrow_store`` clamps
+out-of-range values to the dtype minimum and COUNTS them (never a silent
+two's-complement wrap), so a mis-derived storage plan surfaces as a nonzero
+overflow counter that parity and bench runs assert stays zero (the same
+contract as ``Drops``, core/state.py). simlint's ``compact-store`` rule
+flags narrowing stores that bypass them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# --------------------------------------------------------------------------
+# queue row schema (ops/queues.py; mirrors the reference's Job struct,
+# pkg/scheduler/scheduler.go:65-73 — see ops/queues.py module docstring)
+# --------------------------------------------------------------------------
+
+# (cores, mem, gpu) are contiguous and ordered like the node-tensor resource
+# axis (core/spec.py RES) so JobRec.res is one slice
+QUEUE_FIELDS = ("id", "cores", "mem", "gpu", "dur", "enq_t", "owner",
+                "rec_wait")
+QUEUE_INDEX = {name: i for i, name in enumerate(QUEUE_FIELDS)}
+# invalid-slot sentinel per field: id=-1, owner=OWN(-1), zeros elsewhere
+QUEUE_INVALID = (-1, 0, 0, 0, 0, 0, -1, 0)
+
+# --------------------------------------------------------------------------
+# running-set row schema (ops/runset.py)
+# --------------------------------------------------------------------------
+
+NEVER_I = 2**31 - 1  # end_t sentinel for "no completion scheduled"
+
+# (cores, mem, gpu) contiguous, ordered like spec.RES (release's slice)
+RUN_FIELDS = ("end_t", "node", "cores", "mem", "gpu", "id", "owner", "dur",
+              "enq_t")
+RUN_INDEX = {name: i for i, name in enumerate(RUN_FIELDS)}
+RUN_INVALID = (NEVER_I, 0, 0, 0, 0, -1, -1, 0, 0)
+
+# Fields eligible for sub-int32 storage in the compact layouts. Everything
+# else stays int32 BY DESIGN, not by audit: timestamps, durations, and
+# accumulated waits are unbounded by the config (a stream can carry ms
+# timestamps near 2^31), and end_t must hold the NEVER sentinel. The
+# narrowable set is the fields whose range the config + stream provably
+# bound: resource demands, cluster indices (owner), node indices, and job
+# ids (narrowed only when a stream audit proves the range — the planner
+# keeps i32 otherwise, and the checked store counts any host-injected id
+# beyond the audited bound instead of wrapping).
+NARROWABLE = frozenset({"id", "cores", "mem", "gpu", "owner", "node"})
+
+WIDE_DTYPE = np.dtype(np.int32)
+
+
+# --------------------------------------------------------------------------
+# narrow-storage primitives (re-exported by core/compact.py — the public
+# home; they live here so ops/queues.py can import them without pulling the
+# core package's __init__ into the ops import chain)
+# --------------------------------------------------------------------------
+
+
+# jax < 0.5 ships optimization_barrier without a vmap batching rule, and
+# every SoA queue op runs inside the engine's per-cluster vmap. The barrier
+# is an identity, so the rule is a pass-through — same compat-shim idiom as
+# the shard_map shim in parallel/sharded_engine.py. Falls back to a plain
+# identity (no pinning, only a fusion-dedup pessimization) if the internal
+# primitive moves.
+def _install_barrier_batching():
+    try:
+        from jax._src.lax import lax as _lax_impl
+        from jax.interpreters import batching
+        prim = getattr(_lax_impl, "optimization_barrier_p", None)
+        if prim is None or prim in batching.primitive_batchers:
+            return prim is not None
+        batching.primitive_batchers[prim] = (
+            lambda args, dims: (prim.bind(*args), list(dims)))
+        return True
+    except Exception:  # pragma: no cover - exercised on future jax layouts
+        return False
+
+
+_HAVE_BARRIER = _install_barrier_batching()
+
+
+def pin(*xs):
+    """Materialize shared SoA-op intermediates exactly once.
+
+    A per-field SoA op hands the same mask/rank computation (a one-hot, a
+    cumsum, a live-prefix compare) to NF independent per-leaf consumers;
+    XLA's fuser classifies those producers as cheap and DUPLICATES them
+    into every consumer fusion — NF recomputations of the same [Q]/[S]
+    intermediate (a measured ~40% on the whole tick's bytes accessed).
+    ``optimization_barrier`` pins the values as materialized buffers the
+    consumers share. Only used in the SoA paths: the wide layout has a
+    single consumer per op, so there is nothing to deduplicate."""
+    if not _HAVE_BARRIER:
+        return xs if len(xs) > 1 else xs[0]
+    out = jax.lax.optimization_barrier(xs)
+    return out if len(xs) > 1 else out[0]
+
+
+def widen(leaf: jax.Array) -> jax.Array:
+    """Load a storage leaf for compute: everything is int32 arithmetic, so
+    results are bit-identical to the wide layout (a no-op for i32 leaves —
+    XLA folds the convert)."""
+    return leaf.astype(jnp.int32)
+
+
+def narrow_store(values: jax.Array, dtype, do=None, checked: bool = True):
+    """Checked narrow of int32 compute values into storage dtype ``dtype``.
+
+    Returns ``(stored, n_overflow)``: out-of-range values are clamped to the
+    dtype minimum (a deterministic poison, never a silent wrap) and counted
+    — but only where ``do`` (the store-actually-happens mask; None = all
+    lanes). Callers accumulate ``n_overflow`` into the layout's ``ovf``
+    counter, which parity and bench runs assert stays zero; a nonzero value
+    means the storage plan (core/compact.py) under-sized a field and the
+    run's results can no longer claim bit-equality with the wide layout.
+
+    ``checked=False`` elides the range compare (overflow count is zero by
+    construction) and is ONLY legal for values whose in-range-ness is
+    provable, not assumed: permutations of already-stored leaf values, or
+    moves from a checked storage leaf whose plan bound is covered by the
+    destination's (the plan derives both row kinds from the same bounds
+    table, so queue->runset moves qualify — core/compact.derive_plan).
+    Every range-checking obligation stays at the system's value ENTRY
+    points (arrival ingest, host job injection, market carve), which all
+    pass ``checked=True``; the boundary fuzz tests pin that the counter
+    fires there (tests/test_fuzz_parity.py).
+
+    For int32 ``dtype`` this is a free passthrough: nothing can be out of
+    range, so no compare is emitted.
+    """
+    dtype = np.dtype(dtype)
+    if not checked or dtype.itemsize >= WIDE_DTYPE.itemsize:
+        return values.astype(dtype), jnp.int32(0)
+    info = np.iinfo(dtype)
+    fits = jnp.logical_and(values >= info.min, values <= info.max)
+    bad = jnp.logical_not(fits)
+    bad = bad if do is None else jnp.logical_and(bad, do)
+    stored = jnp.where(fits, values, info.min).astype(dtype)
+    return stored, jnp.sum(bad).astype(jnp.int32)

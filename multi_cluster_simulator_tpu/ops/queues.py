@@ -1,20 +1,35 @@
-"""Padded, mask-disciplined job queues.
+"""Padded, mask-disciplined job queues — wide (AoS) and compact (SoA) forms.
 
 The reference keeps six mutex-guarded Go slices per scheduler (ReadyQueue,
 WaitQueue, LentQueue, BorrowedQueue, Level0, Level1 —
-pkg/scheduler/scheduler.go:19-30). Here a queue is ONE packed int32 tensor
-``data[Q, NF]`` plus a scalar ``count``: valid entries occupy rows
-``[0, count)`` in FIFO order, so "head" is row 0 and append writes row
-``count``. The packed layout matters: queue ops (gather/scatter/roll/where)
-touch one tensor instead of seven, and at 4k clusters per-op dispatch — not
-FLOPs — is the tick-loop cost. All ops are pure, static-shape, and written
-for a single cluster — the engine ``vmap``s them over the cluster axis.
+pkg/scheduler/scheduler.go:19-30). Here a queue is either:
+
+- ``JobQueue`` (wide): ONE packed int32 tensor ``data[Q, NF]`` plus a scalar
+  ``count`` — valid entries occupy rows ``[0, count)`` in FIFO order, so
+  "head" is row 0 and append writes row ``count``. The packed layout keeps
+  the per-op dispatch count low, which was the tick-loop cost at 4k
+  clusters before the tick became memory-bound.
+- ``SoAJobQueue`` (compact): the same queue split into per-field leaves
+  with range-audited storage dtypes (core/compact.py), so a phase that
+  reads only ``enq_t`` streams one narrow column instead of eight int32
+  ones — the bytes/tick lever for the memory-bound headline
+  (ARCHITECTURE.md §state layout). All arithmetic stays int32 (leaves are
+  widened on load); every narrowing store goes through the checked
+  ``fields.narrow_store`` helper, which counts out-of-range values into
+  ``ovf`` instead of wrapping.
+
+Every module-level op below accepts either layout (the engine is
+layout-blind); the two layouts are bit-identical in results by
+construction — integer ops on widened values match the wide ops exactly
+(tests/test_compact.py pins it across the parity matrix).
 
 Row fields mirror the reference's ``Job`` struct (scheduler.go:65-73):
 id, cores, mem, duration, enqueue-time (``WaitTime time.Time``), owner
 (``Ownership string`` — here the borrower's cluster index, -1 for "my own
 job"), plus ``rec_wait``, the last wait recorded in the scheduler's
-``WaitTime.JobsMap`` (scheduler.go:48-63).
+``WaitTime.JobsMap`` (scheduler.go:48-63). The canonical field order /
+invalid sentinels live in ops/fields.py — one site shared with the engine's
+arrival pack paths and the storage planner.
 """
 
 from __future__ import annotations
@@ -25,20 +40,24 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from multi_cluster_simulator_tpu.ops import fields as F
+
 INVALID_ID = jnp.int32(-1)
 OWN = jnp.int32(-1)  # owner value for "my own job" (Ownership == "")
 
-# packed row layout; (cores, mem, gpu) are contiguous and ordered like the
-# node-tensor resource axis (core/spec.py RES) so ``res`` is one slice
-NF = 8
-FID, FCORES, FMEM, FGPU, FDUR, FENQ, FOWNER, FREC = range(NF)
+# packed row layout, derived from the canonical schema (ops/fields.py)
+NF = len(F.QUEUE_FIELDS)
+FID, FCORES, FMEM, FGPU, FDUR, FENQ, FOWNER, FREC = (
+    F.QUEUE_INDEX[n] for n in F.QUEUE_FIELDS)
+_FIDX = dict(F.QUEUE_INDEX)
 
-_INVALID_ROW = jnp.array([-1, 0, 0, 0, 0, 0, -1, 0], jnp.int32)  # id=-1, owner=OWN
+_INVALID_ROW = jnp.array(F.QUEUE_INVALID, jnp.int32)
 
 
 @struct.dataclass
 class JobRec:
-    """A single job: one packed [NF] int32 row."""
+    """A single job: one packed [NF] int32 row (both layouts hand jobs
+    around in this wide form — it is compute, not storage)."""
 
     vec: jax.Array
 
@@ -96,10 +115,6 @@ class JobRec:
         return JobRec(vec=vec)
 
 
-_FIDX = {"id": FID, "cores": FCORES, "mem": FMEM, "gpu": FGPU, "dur": FDUR,
-         "enq_t": FENQ, "owner": FOWNER, "rec_wait": FREC}
-
-
 @struct.dataclass
 class JobQueue:
     data: jax.Array  # [Q, NF] int32
@@ -147,50 +162,248 @@ class JobQueue:
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.count
 
 
+@struct.dataclass
+class SoAJobQueue:
+    """The compact layout: one leaf per field, storage dtypes from a
+    ``CompactPlan`` (core/compact.py), plus the narrow-store overflow
+    counter ``ovf`` (a ``Drops``-style surface-don't-swallow counter —
+    parity and bench runs assert it stays zero).
+
+    Leaves are named ``f_<field>`` (not the field name itself) so the
+    widened accessors below can keep the wide layout's property API: code
+    reading ``q.cores`` always gets int32 compute values, whatever the
+    storage width. Direct stores into ``f_*`` leaves must go through
+    ``fields.narrow_store`` — simlint's ``compact-store`` rule flags
+    bypasses."""
+
+    f_id: jax.Array  # [Q]
+    f_cores: jax.Array
+    f_mem: jax.Array
+    f_gpu: jax.Array
+    f_dur: jax.Array
+    f_enq_t: jax.Array
+    f_owner: jax.Array
+    f_rec_wait: jax.Array
+    count: jax.Array  # [] int32
+    ovf: jax.Array  # [] int32 — checked-narrow overflow events
+
+    @property
+    def capacity(self) -> int:
+        return self.f_id.shape[-1]
+
+    # widened field views — same API (and dtype) as the wide layout's
+    @property
+    def id(self):
+        return F.widen(self.f_id)
+
+    @property
+    def cores(self):
+        return F.widen(self.f_cores)
+
+    @property
+    def mem(self):
+        return F.widen(self.f_mem)
+
+    @property
+    def gpu(self):
+        return F.widen(self.f_gpu)
+
+    @property
+    def dur(self):
+        return F.widen(self.f_dur)
+
+    @property
+    def enq_t(self):
+        return F.widen(self.f_enq_t)
+
+    @property
+    def owner(self):
+        return F.widen(self.f_owner)
+
+    @property
+    def rec_wait(self):
+        return F.widen(self.f_rec_wait)
+
+    def slot_valid(self) -> jax.Array:
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.count
+
+
+def _leaf(q: SoAJobQueue, name: str) -> jax.Array:
+    return getattr(q, "f_" + name)
+
+
+def _invalid(name: str, dtype) -> jax.Array:
+    return jnp.asarray(F.QUEUE_INVALID[_FIDX[name]], dtype)
+
+
+def field(q, name: str) -> jax.Array:
+    """[..., Q] int32 view of one field, either layout."""
+    if isinstance(q, SoAJobQueue):
+        return F.widen(_leaf(q, name))
+    return q.data[..., _FIDX[name]]
+
+
+def rows_of(q) -> jax.Array:
+    """[..., Q, NF] int32 packed rows of either layout — the wide compute
+    form the whole-row contractions run in (stacking the SoA leaves once
+    beats re-materializing a one-hot operand per field)."""
+    if isinstance(q, SoAJobQueue):
+        return jnp.stack([F.widen(_leaf(q, n)) for n in F.QUEUE_FIELDS],
+                         axis=-1)
+    return q.data
+
+
+def _replace_fields(q: SoAJobQueue, new: dict, count=None, ovf=None):
+    kw = {"f_" + n: v for n, v in new.items()}
+    kw.update({} if count is None else {"count": count})
+    kw.update({} if ovf is None else {"ovf": ovf})
+    return q.replace(**kw)
+
+
 def empty(capacity: int) -> JobQueue:
     return JobQueue(data=jnp.broadcast_to(_INVALID_ROW, (capacity, NF)).copy(),
                     count=jnp.int32(0))
 
 
+def empty_soa(capacity: int, dtypes: dict) -> SoAJobQueue:
+    """Compact-layout empty queue; ``dtypes`` maps field name -> storage
+    dtype (CompactPlan.queue_dtypes())."""
+    leaves = {
+        "f_" + n: jnp.full((capacity,), F.QUEUE_INVALID[i], dtypes[n])
+        for i, n in enumerate(F.QUEUE_FIELDS)}
+    return SoAJobQueue(count=jnp.int32(0), ovf=jnp.int32(0), **leaves)
+
+
+def soa_to_wide(q: SoAJobQueue) -> JobQueue:
+    """Canonicalize a compact queue to the wide layout (widen + restack) —
+    compact-vs-wide equality checks compare in this form. Works on batched
+    ([C, Q]-leaf) queues too. The ``ovf`` counter is dropped; assert it
+    zero separately."""
+    data = jnp.stack([F.widen(_leaf(q, n)) for n in F.QUEUE_FIELDS], axis=-1)
+    return JobQueue(data=data, count=jnp.asarray(q.count, jnp.int32))
+
+
 def from_fields(id, cores, mem, gpu, dur, enq_t, owner, rec_wait, count) -> JobQueue:
-    """Build a queue from per-field [Q] arrays (one stack op)."""
+    """Build a wide queue from per-field [Q] arrays (one stack op)."""
     data = jnp.stack([id, cores, mem, gpu, dur, enq_t, owner, rec_wait],
                      axis=-1).astype(jnp.int32)
     return JobQueue(data=data, count=jnp.asarray(count, jnp.int32))
 
 
-def get(q: JobQueue, i: Any) -> JobRec:
+def get(q, i: Any) -> JobRec:
+    if isinstance(q, SoAJobQueue):
+        return JobRec(vec=jnp.stack(
+            [F.widen(_leaf(q, n))[i] for n in F.QUEUE_FIELDS], axis=-1))
     return JobRec(vec=q.data[i])
 
 
-def head(q: JobQueue) -> JobRec:
+def head(q) -> JobRec:
     return get(q, 0)
 
 
-def push_back(q: JobQueue, job: JobRec, do: jax.Array) -> JobQueue:
+def select_row(q, hot: jax.Array) -> JobRec:
+    """The row whose one-hot mask is ``hot`` [Q], as a one-hot contraction
+    (dynamic row gathers serialize when vmapped over thousands of clusters
+    — see the sweep loops in core/engine.py)."""
+    h = hot.astype(jnp.int32)
+    if isinstance(q, SoAJobQueue):
+        return JobRec(vec=jnp.stack(
+            [jnp.einsum("q,q->", h, F.widen(_leaf(q, n)))
+             for n in F.QUEUE_FIELDS], axis=-1))
+    return JobRec(vec=jnp.einsum("q,qf->f", h, q.data))
+
+
+def rows_prefix(q, n: int) -> jax.Array:
+    """The first ``n`` slots as packed [n, NF] int32 rows (sweep-order job
+    batches for the wave kernels)."""
+    if isinstance(q, SoAJobQueue):
+        return jnp.stack([F.widen(_leaf(q, f))[:n] for f in F.QUEUE_FIELDS],
+                         axis=-1)
+    return q.data[:n]
+
+
+def gather_rows(q, sel: jax.Array) -> jax.Array:
+    """Packed [K, NF] int32 rows selected by a [K, Q] one-hot matrix (the
+    BFD-ordered gather in the FFD sweeps) — integer contractions, exact."""
+    s = sel.astype(jnp.int32)
+    if isinstance(q, SoAJobQueue):
+        return jnp.stack([jnp.einsum("kq,q->k", s, F.widen(_leaf(q, n)))
+                          for n in F.QUEUE_FIELDS], axis=-1)
+    return jnp.einsum("kq,qf->kf", s, q.data)
+
+
+def push_back(q, job: JobRec, do: jax.Array):
     """Append one job if ``do`` (and capacity allows). One-hot select, not
     scatter — scatters serialize on TPU and this is per-tick hot."""
     ok = jnp.logical_and(do, q.count < q.capacity)
     hot = jnp.logical_and(jnp.arange(q.capacity, dtype=jnp.int32) == q.count, ok)
+    if isinstance(q, SoAJobQueue):
+        hot, ok = F.pin(hot, ok)
+        new, bad = {}, q.ovf
+        for n in F.QUEUE_FIELDS:
+            leaf = _leaf(q, n)
+            stored, nbad = F.narrow_store(job.vec[..., _FIDX[n]], leaf.dtype,
+                                          do=ok)
+            new[n] = jnp.where(hot, stored, leaf)
+            bad = bad + nbad
+        return _replace_fields(q, new, count=q.count + ok.astype(jnp.int32),
+                               ovf=bad)
     data = jnp.where(hot[:, None], job.vec, q.data)
     return q.replace(data=data, count=q.count + ok.astype(jnp.int32))
 
 
-def push_many(q: JobQueue, jobs: JobQueue, take: jax.Array,
-              prefix: bool = False) -> JobQueue:
+def push_many(q, jobs, take: jax.Array, prefix: bool = False):
     """Append all rows of ``jobs`` where ``take`` is set, preserving order.
 
     ``take`` is a [Qj] bool mask over ``jobs`` slots. Overflowing entries are
     dropped (sized configs should make this impossible). ``prefix=True``
     asserts the mask is a leading prefix (e.g. time-sorted arrival ingestion)
     and skips the stable argsort — a per-tick hot path at scale.
+
+    ``jobs`` may be either layout (the engine's ingest and borrow paths hand
+    in small wide batches regardless of the state layout).
     """
     n_take = jnp.sum(take).astype(jnp.int32)
-    src = jobs.data if prefix else jobs.data[jnp.argsort(jnp.logical_not(take),
-                                                         stable=True)]
-    dst = q.count + jnp.arange(jobs.capacity, dtype=jnp.int32)  # k-th taken row
-    ok = jnp.logical_and(jnp.arange(jobs.capacity) < n_take, dst < q.capacity)
-    if prefix and jobs.capacity <= 128:
+    jcap = jobs.capacity
+    dst = q.count + jnp.arange(jcap, dtype=jnp.int32)  # k-th taken row
+    ok = jnp.logical_and(jnp.arange(jcap) < n_take, dst < q.capacity)
+    added = jnp.minimum(n_take, q.capacity - q.count)
+    if isinstance(q, SoAJobQueue):
+        order = (None if prefix
+                 else jnp.argsort(jnp.logical_not(take), stable=True))
+        new, bad = {}, q.ovf
+        if prefix and jcap <= 128:
+            # per-tick hot path (arrival ingest): ONE one-hot contraction on
+            # the packed int32 rows (scatters serialize on TPU — see the
+            # wide path below), then each column narrows into its leaf;
+            # a per-field contraction re-materializes the [cap, Qj] one-hot
+            # NF times (measured ~2x on the whole op)
+            hot = jnp.logical_and(
+                dst[None, :] == jnp.arange(q.capacity, dtype=jnp.int32)[:, None],
+                ok[None, :])  # [cap, Qj]
+            written = F.pin(jnp.any(hot, axis=1))
+            src = rows_of(jobs)
+            packed = hot.astype(src.dtype) @ src  # [cap, NF]
+            for n in F.QUEUE_FIELDS:
+                leaf = _leaf(q, n)
+                stored, nbad = F.narrow_store(packed[:, _FIDX[n]],
+                                              leaf.dtype, do=written)
+                new[n] = jnp.where(written, stored, leaf)
+                bad = bad + nbad
+        else:
+            dstc, ok = F.pin(jnp.where(ok, dst, q.capacity), ok)
+            for n in F.QUEUE_FIELDS:
+                leaf = _leaf(q, n)
+                src = field(jobs, n)
+                src = src if order is None else src[order]
+                stored, nbad = F.narrow_store(src, leaf.dtype, do=ok)
+                new[n] = leaf.at[dstc].set(stored, mode="drop")
+                bad = bad + nbad
+        return _replace_fields(q, new, count=q.count + added, ovf=bad)
+    src = (jobs if isinstance(jobs, JobQueue) else soa_to_wide(jobs)).data
+    if not prefix:
+        src = src[jnp.argsort(jnp.logical_not(take), stable=True)]
+    if prefix and jcap <= 128:
         # per-tick hot path (arrival ingest): scatter as a one-hot
         # contraction — scatters serialize on TPU. O(cap x Qj), so only for
         # small source batches; the borrow path (source capacity == total
@@ -204,39 +417,54 @@ def push_many(q: JobQueue, jobs: JobQueue, take: jax.Array,
     else:
         dst = jnp.where(ok, dst, q.capacity)  # out-of-range writes dropped
         data = q.data.at[dst].set(src, mode="drop")
-    added = jnp.minimum(n_take, q.capacity - q.count)
     return q.replace(data=data, count=q.count + added)
 
 
-def push_back_dropped(q: JobQueue, do: jax.Array) -> jax.Array:
+def push_back_dropped(q, do: jax.Array) -> jax.Array:
     """0/1: whether push_back(q, ., do) would overflow (SimState.drops)."""
     return jnp.logical_and(do, q.count >= q.capacity).astype(jnp.int32)
 
 
-def push_many_dropped(q: JobQueue, take: jax.Array) -> jax.Array:
+def push_many_dropped(q, take: jax.Array) -> jax.Array:
     """How many of ``take`` push_many(q, ., take) would overflow."""
     n_take = jnp.sum(take).astype(jnp.int32)
     return jnp.maximum(n_take - (q.capacity - q.count), 0)
 
 
-def pop_front(q: JobQueue, do: jax.Array) -> JobQueue:
+def pop_front(q, do: jax.Array):
     """Drop the head job if ``do`` (FIFO pop), shifting everything left."""
+    count = jnp.maximum(q.count - do.astype(jnp.int32), 0)
+    if isinstance(q, SoAJobQueue):
+        new = {}
+        for n in F.QUEUE_FIELDS:
+            leaf = _leaf(q, n)
+            shifted = jnp.roll(leaf, -1).at[-1].set(_invalid(n, leaf.dtype))
+            new[n] = jnp.where(do, shifted, leaf)
+        return _replace_fields(q, new, count=count)
     shifted = jnp.roll(q.data, -1, axis=0).at[-1].set(_INVALID_ROW)
     data = jnp.where(do, shifted, q.data)
-    return q.replace(data=data, count=jnp.maximum(q.count - do.astype(jnp.int32), 0))
+    return q.replace(data=data, count=count)
 
 
-def pop_front_n(q: JobQueue, n: jax.Array) -> JobQueue:
+def pop_front_n(q, n: jax.Array):
     """Drop the first ``n`` jobs (FIFO pop of a prefix) — one dynamic roll
     instead of the general compact()'s argsort."""
     n = jnp.clip(n, 0, q.count)
     newcount = q.count - n
     live = jnp.arange(q.capacity, dtype=jnp.int32) < newcount
+    if isinstance(q, SoAJobQueue):
+        live, n = F.pin(live, n)
+        new = {}
+        for f in F.QUEUE_FIELDS:
+            leaf = _leaf(q, f)
+            new[f] = jnp.where(live, jnp.roll(leaf, -n),
+                               _invalid(f, leaf.dtype))
+        return _replace_fields(q, new, count=newcount)
     data = jnp.where(live[:, None], jnp.roll(q.data, -n, axis=0), _INVALID_ROW)
     return q.replace(data=data, count=newcount)
 
 
-def compact(q: JobQueue, keep: jax.Array) -> JobQueue:
+def compact(q, keep: jax.Array):
     """Stable-remove all valid slots where ``keep`` is False.
 
     This is the tensor analogue of the Go in-place slice deletions
@@ -249,10 +477,39 @@ def compact(q: JobQueue, keep: jax.Array) -> JobQueue:
     was a measured ~2 ms/tick at 4k clusters. Large capacities keep the
     argsort+gather form: a [Q, Q] one-hot operand scales quadratically in
     memory.
+
+    Compaction only PERMUTES already-stored values (plus the in-range
+    invalid fill), so the SoA narrow stores here can never overflow; they
+    still ride the checked helper for a single uniform store discipline.
     """
     keep = jnp.logical_and(keep, q.slot_valid())
     n_keep = jnp.sum(keep).astype(jnp.int32)
     live = jnp.arange(q.capacity, dtype=jnp.int32) < n_keep
+    if isinstance(q, SoAJobQueue):
+        live = F.pin(live)
+        new, bad = {}, q.ovf
+        if q.capacity <= 256:
+            dest = jnp.cumsum(keep.astype(jnp.int32)) - 1  # rank among kept
+            hot = jnp.logical_and(
+                dest[None, :] == jnp.arange(q.capacity)[:, None],
+                keep[None, :])  # [dst, src]
+            packed = hot.astype(jnp.int32) @ rows_of(q)  # ONE contraction
+            for n in F.QUEUE_FIELDS:
+                leaf = _leaf(q, n)
+                # checked=False: compaction permutes this queue's own
+                # already-stored values (see the docstring above)
+                stored, nbad = F.narrow_store(packed[:, _FIDX[n]],
+                                              leaf.dtype, do=live,
+                                              checked=False)
+                new[n] = jnp.where(live, stored, _invalid(n, leaf.dtype))
+                bad = bad + nbad
+        else:
+            order = F.pin(jnp.argsort(jnp.logical_not(keep), stable=True))
+            for n in F.QUEUE_FIELDS:
+                leaf = _leaf(q, n)
+                new[n] = jnp.where(live, leaf[order],
+                                   _invalid(n, leaf.dtype))
+        return _replace_fields(q, new, count=n_keep, ovf=bad)
     if q.capacity <= 256:
         dest = jnp.cumsum(keep.astype(jnp.int32)) - 1  # rank among kept
         hot = jnp.logical_and(dest[None, :] == jnp.arange(q.capacity)[:, None],
@@ -266,11 +523,34 @@ def compact(q: JobQueue, keep: jax.Array) -> JobQueue:
 
 
 def set_col(q: JobQueue, col: int, values: jax.Array) -> JobQueue:
-    """Overwrite one field column (e.g. rec_wait) for all slots."""
+    """Overwrite one field column by index (wide layout only — layout-blind
+    callers use ``set_field``)."""
     return q.replace(data=q.data.at[..., col].set(values.astype(jnp.int32)))
 
 
-def remove_matching(q: JobQueue, job: JobRec, match_fields=("id", "cores", "mem", "dur")) -> JobQueue:
+def set_field(q, name: str, values: jax.Array):
+    """Overwrite one field column (e.g. rec_wait) for all slots."""
+    if isinstance(q, SoAJobQueue):
+        leaf = _leaf(q, name)
+        stored, nbad = F.narrow_store(jnp.asarray(values, jnp.int32),
+                                      leaf.dtype)
+        return _replace_fields(q, {name: stored}, ovf=q.ovf + nbad)
+    return set_col(q, _FIDX[name], values)
+
+
+def set_field_elem(q, name: str, i, value):
+    """Overwrite one field of one slot (e.g. the head's rec_wait)."""
+    if isinstance(q, SoAJobQueue):
+        leaf = _leaf(q, name)
+        stored, nbad = F.narrow_store(jnp.asarray(value, jnp.int32),
+                                      leaf.dtype)
+        return _replace_fields(q, {name: leaf.at[i].set(stored)},
+                               ovf=q.ovf + nbad)
+    return q.replace(data=q.data.at[i, _FIDX[name]].set(
+        jnp.asarray(value, jnp.int32)))
+
+
+def remove_matching(q, job: JobRec, match_fields=("id", "cores", "mem", "dur")):
     """Remove entries equal to ``job`` on the given fields.
 
     Mirrors the reference's whole-struct-equality dequeues
@@ -281,5 +561,5 @@ def remove_matching(q: JobQueue, job: JobRec, match_fields=("id", "cores", "mem"
     """
     m = jnp.ones((q.capacity,), bool)
     for f in match_fields:
-        m = jnp.logical_and(m, q.data[..., _FIDX[f]] == job.vec[..., _FIDX[f]])
+        m = jnp.logical_and(m, field(q, f) == job.vec[..., _FIDX[f]])
     return compact(q, jnp.logical_not(m))

@@ -1,13 +1,22 @@
-"""Running-job occupancy set.
+"""Running-job occupancy set — wide (AoS) and compact (SoA) forms.
 
 The reference simulates execution with one goroutine per running job:
 decrement node counters, ``time.Sleep(j.Duration)``, increment them back,
 notify the scheduler (Node.RunJob, pkg/scheduler/cluster.go:141-161). Here a
-running job is a row in one packed int32 table carrying its end time on the
+running job is a row in a packed table carrying its end time on the
 virtual clock; completion is a masked scatter-add back into the free tensor —
 no goroutines, no sleeps, and completion notification (JobFinished,
-scheduler.go:158-191) is a mask the engine consumes. Packed rows keep the
-per-tick op count low (see ops/queues.py).
+scheduler.go:158-191) is a mask the engine consumes.
+
+Like the job queues (ops/queues.py), the set exists in two bit-identical
+layouts: the wide ``RunningSet`` (one int32 ``data[S, RF]`` tensor) and the
+compact ``SoARunningSet`` (per-field leaves with range-audited storage
+dtypes from core/compact.py — the [S]-sized set is the largest per-cluster
+tensor in the headline shape, so its bytes dominate the memory-bound tick).
+All arithmetic is int32 on widened loads; narrowing stores ride the checked
+``fields.narrow_store`` helper and count overflows into ``ovf``. The row
+schema (order + invalid sentinels) is ops/fields.RUN_FIELDS — one site
+shared with the queue schema and the storage planner.
 """
 
 from __future__ import annotations
@@ -16,15 +25,17 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from multi_cluster_simulator_tpu.ops import fields as F
 from multi_cluster_simulator_tpu.ops.queues import JobRec
 
-NEVER = jnp.int32(2**31 - 1)
+NEVER = jnp.int32(F.NEVER_I)
 
-# packed row layout; (cores, mem, gpu) contiguous, ordered like spec.RES
-RF = 9
-REND, RNODE, RCORES, RMEM, RGPU, RID, ROWNER, RDUR, RENQ = range(RF)
+# packed row layout, derived from the canonical schema (ops/fields.py)
+RF = len(F.RUN_FIELDS)
+REND, RNODE, RCORES, RMEM, RGPU, RID, ROWNER, RDUR, RENQ = (
+    F.RUN_INDEX[n] for n in F.RUN_FIELDS)
 
-_INVALID_ROW = jnp.array([NEVER, 0, 0, 0, 0, -1, -1, 0, 0], jnp.int32)
+_INVALID_ROW = jnp.array(F.RUN_INVALID, jnp.int32)
 
 
 @struct.dataclass
@@ -73,10 +84,107 @@ class RunningSet:
         return self.data[..., RENQ]
 
 
+@struct.dataclass
+class SoARunningSet:
+    """Compact layout: per-field leaves (``f_<name>``, storage dtypes from a
+    CompactPlan) + the checked-narrow overflow counter ``ovf``. The widened
+    accessors keep the wide layout's property API — readers always get
+    int32. Stores into ``f_*`` leaves must go through
+    ``fields.narrow_store`` (simlint: compact-store)."""
+
+    f_end_t: jax.Array  # [S]
+    f_node: jax.Array
+    f_cores: jax.Array
+    f_mem: jax.Array
+    f_gpu: jax.Array
+    f_id: jax.Array
+    f_owner: jax.Array
+    f_dur: jax.Array
+    f_enq_t: jax.Array
+    active: jax.Array  # [S] bool
+    ovf: jax.Array  # [] int32
+
+    @property
+    def capacity(self) -> int:
+        return self.active.shape[-1]
+
+    @property
+    def end_t(self):
+        return F.widen(self.f_end_t)
+
+    @property
+    def node(self):
+        return F.widen(self.f_node)
+
+    @property
+    def cores(self):
+        return F.widen(self.f_cores)
+
+    @property
+    def mem(self):
+        return F.widen(self.f_mem)
+
+    @property
+    def gpu(self):
+        return F.widen(self.f_gpu)
+
+    @property
+    def id(self):
+        return F.widen(self.f_id)
+
+    @property
+    def owner(self):
+        return F.widen(self.f_owner)
+
+    @property
+    def dur(self):
+        return F.widen(self.f_dur)
+
+    @property
+    def enq_t(self):
+        return F.widen(self.f_enq_t)
+
+
+def _leaf(rs: SoARunningSet, name: str) -> jax.Array:
+    return getattr(rs, "f_" + name)
+
+
+def _invalid(name: str, dtype) -> jax.Array:
+    return jnp.asarray(F.RUN_INVALID[F.RUN_INDEX[name]], dtype)
+
+
 def empty(capacity: int) -> RunningSet:
     return RunningSet(
         data=jnp.broadcast_to(_INVALID_ROW, (capacity, RF)).copy(),
         active=jnp.zeros((capacity,), bool))
+
+
+def empty_soa(capacity: int, dtypes: dict) -> SoARunningSet:
+    """Compact-layout empty set; ``dtypes`` maps field name -> storage dtype
+    (CompactPlan.run_dtypes())."""
+    leaves = {
+        "f_" + n: jnp.full((capacity,), F.RUN_INVALID[i], dtypes[n])
+        for i, n in enumerate(F.RUN_FIELDS)}
+    return SoARunningSet(active=jnp.zeros((capacity,), bool),
+                         ovf=jnp.int32(0), **leaves)
+
+
+def soa_to_wide(rs: SoARunningSet) -> RunningSet:
+    """Canonicalize to the wide layout (widen + restack; batched leaves ok).
+    ``ovf`` is dropped — assert it zero separately."""
+    data = jnp.stack([F.widen(_leaf(rs, n)) for n in F.RUN_FIELDS], axis=-1)
+    return RunningSet(data=data, active=rs.active)
+
+
+def gather_rows_along(rs, order: jax.Array) -> jax.Array:
+    """[..., M, RF] int32 rows selected along the slot axis by ``order``
+    [..., M] (batched; the finished-foreign message pack,
+    engine._pack_returns)."""
+    if isinstance(rs, SoARunningSet):
+        return jnp.stack(
+            [jnp.take_along_axis(F.widen(_leaf(rs, n)), order, axis=-1)
+             for n in F.RUN_FIELDS], axis=-1)
+    return jnp.take_along_axis(rs.data, order[..., None], axis=-2)
 
 
 def make_row(end_t, node, cores, mem, gpu, id, owner, dur, enq_t) -> jax.Array:
@@ -89,22 +197,40 @@ def row_from_job(job: JobRec, node, t) -> jax.Array:
                     job.owner, job.dur, job.enq_t)
 
 
-def start(rs: RunningSet, job: JobRec, node: jax.Array, t: jax.Array, do: jax.Array) -> RunningSet:
-    """Occupy the first free slot with a newly placed job (end = t + dur).
+def insert_row(rs, hot: jax.Array, row: jax.Array):
+    """Write one packed int32 ``row`` into the slots where ``hot`` [S] is
+    set (one-hot in practice) and mark them active. One-hot select, not
+    scatter — scatters serialize on TPU. The generic single-row insert
+    shared by ``start``, the market's Foreign-placeholder carve
+    (market/trader.py), and the live host's carve path
+    (services/host_ops.py)."""
+    if isinstance(rs, SoARunningSet):
+        hot = F.pin(hot)
+        do = jnp.any(hot)
+        new, bad = {}, rs.ovf
+        for n in F.RUN_FIELDS:
+            leaf = _leaf(rs, n)
+            stored, nbad = F.narrow_store(row[..., F.RUN_INDEX[n]],
+                                          leaf.dtype, do=do)
+            new[n] = jnp.where(hot, stored, leaf)
+            bad = bad + nbad
+        return rs.replace(active=jnp.logical_or(rs.active, hot),
+                          ovf=bad, **{"f_" + n: v for n, v in new.items()})
+    return RunningSet(data=jnp.where(hot[:, None], row, rs.data),
+                      active=jnp.logical_or(rs.active, hot))
 
-    The slot write is a one-hot select, not a scatter — scatters serialize
-    on TPU and this runs once per placement-sweep step."""
+
+def start(rs, job: JobRec, node: jax.Array, t: jax.Array, do: jax.Array):
+    """Occupy the first free slot with a newly placed job (end = t + dur)."""
     slot = jnp.argmin(rs.active).astype(jnp.int32)  # first inactive slot
     ok = jnp.logical_and(do, jnp.logical_not(rs.active[slot]))
     row = row_from_job(job, node, t)
     hot = jnp.logical_and(
         jnp.arange(rs.capacity, dtype=jnp.int32) == slot, ok)  # [S]
-    data = jnp.where(hot[:, None], row, rs.data)
-    active = jnp.logical_or(rs.active, hot)
-    return RunningSet(data=data, active=active)
+    return insert_row(rs, hot, row)
 
 
-def start_many(rs: RunningSet, rows: jax.Array, n_take: jax.Array) -> RunningSet:
+def start_many(rs, rows: jax.Array, n_take: jax.Array):
     """Batch-insert ``rows[:n_take]`` (insertion order) into the lowest
     inactive slots, ascending — the exact slot layout a sequence of
     ``start`` calls produces, at one [S, M] contraction instead of M
@@ -123,11 +249,46 @@ def start_many(rs: RunningSet, rows: jax.Array, n_take: jax.Array) -> RunningSet
         jnp.logical_and(free_rank[:, None] == j[None, :], inactive[:, None]),
         (j < n_take)[None, :])  # [S, M]
     written = jnp.any(hot, axis=1)
+    if isinstance(rs, SoARunningSet):
+        # Narrowing here is checked=False by provenance: every narrowable
+        # column of a runset row comes from a checked queue leaf
+        # (row_from_job copies the job's fields) or a config-bounded index
+        # (first_fit node < total_nodes), and the plan derives the runset
+        # bounds from the same table as the queue bounds — nothing fresh
+        # enters the system at this site (fields.narrow_store docstring).
+        new, bad = {}, rs.ovf
+        if M == 1:
+            # single-row insert (the _attempt head-placement path): scalar
+            # broadcast stores — the [S, RF] outer-product form below is
+            # "cheap" to XLA's fuser, which duplicates it into every
+            # per-field consumer (a measured ~9x on this op's bytes)
+            hot1 = F.pin(hot[:, 0])
+            for n in F.RUN_FIELDS:
+                leaf = _leaf(rs, n)
+                stored, nbad = F.narrow_store(rows[0, F.RUN_INDEX[n]],
+                                              leaf.dtype, checked=False)
+                new[n] = jnp.where(hot1, stored, leaf)
+                bad = bad + nbad
+        else:
+            # ONE one-hot matmul in wide int32 (compute), then each column
+            # narrows into its leaf — a per-field contraction would
+            # re-materialize the [S, M] one-hot RF times
+            packed = hot.astype(rows.dtype) @ rows  # [S, RF]
+            written = F.pin(written)
+            for n in F.RUN_FIELDS:
+                leaf = _leaf(rs, n)
+                stored, nbad = F.narrow_store(packed[:, F.RUN_INDEX[n]],
+                                              leaf.dtype, do=written,
+                                              checked=False)
+                new[n] = jnp.where(written, stored, leaf)
+                bad = bad + nbad
+        return rs.replace(active=jnp.logical_or(rs.active, written),
+                          ovf=bad, **{"f_" + n: v for n, v in new.items()})
     data = jnp.where(written[:, None], hot.astype(rows.dtype) @ rows, rs.data)
     return RunningSet(data=data, active=jnp.logical_or(rs.active, written))
 
 
-def next_end_t(rs: RunningSet) -> jax.Array:
+def next_end_t(rs) -> jax.Array:
     """Earliest completion time in the set (NEVER when empty) — the
     min-``end_t`` probe the event-compressed driver folds into its
     next-event time (core/engine.py _next_event_t): no release can fire
@@ -135,7 +296,7 @@ def next_end_t(rs: RunningSet) -> jax.Array:
     return jnp.min(jnp.where(rs.active, rs.end_t, NEVER))
 
 
-def release(rs: RunningSet, free: jax.Array, t: jax.Array):
+def release(rs, free: jax.Array, t: jax.Array):
     """Complete all jobs with ``end_t <= t``: return their resources to
     ``free`` (RunJob's increment half, cluster.go:153-157) and clear slots.
 
@@ -145,10 +306,23 @@ def release(rs: RunningSet, free: jax.Array, t: jax.Array):
     done = jnp.logical_and(rs.active, rs.end_t <= t)
     n_nodes = free.shape[0]
     node_idx = jnp.clip(rs.node, 0, n_nodes - 1)
-    back = jnp.where(done[:, None], rs.data[:, RCORES:RCORES + free.shape[-1]], 0)
+    if isinstance(rs, SoARunningSet):
+        res = jnp.stack([rs.cores, rs.mem, rs.gpu],
+                        axis=-1)[:, : free.shape[-1]]
+    else:
+        res = rs.data[:, RCORES:RCORES + free.shape[-1]]
+    back = jnp.where(done[:, None], res, 0)
     # scatter-add as a one-hot contraction (scatters serialize on TPU)
     hot = (node_idx[:, None] == jnp.arange(n_nodes, dtype=jnp.int32)[None, :])
     free = free + jnp.einsum("sn,sr->nr", hot.astype(back.dtype), back)
+    if isinstance(rs, SoARunningSet):
+        done = F.pin(done)
+        new = {("f_" + n): jnp.where(done, _invalid(n, _leaf(rs, n).dtype),
+                                     _leaf(rs, n))
+               for n in F.RUN_FIELDS}
+        rs = rs.replace(active=jnp.logical_and(rs.active,
+                                               jnp.logical_not(done)), **new)
+        return rs, free, done
     rs = RunningSet(
         data=jnp.where(done[:, None], _INVALID_ROW, rs.data),
         active=jnp.logical_and(rs.active, jnp.logical_not(done)))

@@ -130,10 +130,9 @@ def carve_occupy(state: SimState, cores, mem, dur_ms,
         okk = jnp.logical_and(occ, jnp.logical_not(rn.active[slot]))
         row = R.make_row(t + dur, n, amounts[n, CORES], amounts[n, MEM],
                          amounts[n, GPU], PLACEHOLDER_ID, FOREIGN, dur, t)
-        return R.RunningSet(
-            data=rn.data.at[slot].set(jnp.where(okk, row, rn.data[slot])),
-            active=rn.active.at[slot].set(
-                jnp.where(okk, True, rn.active[slot]))), None
+        hot = jnp.logical_and(
+            jnp.arange(rn.capacity, dtype=jnp.int32) == slot, okk)
+        return R.insert_row(rn, hot, row), None
 
     run0, _ = jax.lax.scan(add_placeholder, _c0(state.run),
                            jnp.arange(free0.shape[0], dtype=jnp.int32))

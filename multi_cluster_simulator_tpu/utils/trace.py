@@ -60,10 +60,18 @@ def check_conservation(state: SimState) -> None:
 
 def total_drops(state: SimState) -> dict:
     """Summed SimState.drops counters — every one should be zero on a
-    correctly sized config (see core/state.py Drops)."""
+    correctly sized config (see core/state.py Drops). ``narrow`` is the
+    compact layouts' checked-narrow overflow total (core/compact.py):
+    always zero for wide states, and zero for compact states whose storage
+    plan actually covers the workload's ranges — a nonzero value means a
+    narrowing store clamped instead of silently wrapping."""
+    from multi_cluster_simulator_tpu.core.compact import overflow_total
+
     d = state.drops
-    return {k: int(np.asarray(getattr(d, k)).sum())
-            for k in ("queue", "msgs", "run_full", "vslot", "carve", "ingest")}
+    out = {k: int(np.asarray(getattr(d, k)).sum())
+           for k in ("queue", "msgs", "run_full", "vslot", "carve", "ingest")}
+    out["narrow"] = overflow_total(state)
+    return out
 
 
 def assert_no_drops(state: SimState) -> None:

@@ -1,0 +1,23 @@
+"""Fixture: narrowing stores that bypass the checked helper — both forms
+the compact-store rule must flag (literal narrow cast, unchecked f_ leaf
+store)."""
+
+import jax.numpy as jnp
+
+
+def ingest_row(q, row):
+    # BAD: literal narrow cast — wraps out-of-range values silently
+    cores = row[1].astype(jnp.int8)
+    # BAD: direct store into a compact leaf without narrow_store
+    return q.replace(f_cores=q.f_cores.at[0].set(cores))
+
+
+def record_job(q, job):
+    # BAD: a widened accessor property (int32 compute) stored straight
+    # into a narrow leaf — jax casts with two's-complement wrap
+    return q.replace(f_mem=q.f_mem.at[0].set(job.mem))
+
+
+def stage_buffer(vals):
+    # BAD: ad-hoc narrow constructor instead of a CompactPlan dtype
+    return jnp.asarray(vals, jnp.int16)

@@ -1,0 +1,20 @@
+"""Fixture: the paired clean version — the same stores routed through the
+checked-narrow helper (and a pure rearrangement, which needs no check:
+it only permutes values an earlier checked store admitted)."""
+
+import jax.numpy as jnp
+
+from multi_cluster_simulator_tpu.ops.fields import narrow_store
+
+
+def ingest_row(q, row):
+    stored, nbad = narrow_store(row[1], q.f_cores.dtype)
+    return q.replace(f_cores=q.f_cores.at[0].set(stored),
+                     ovf=q.ovf + nbad)
+
+
+def pop_front(q, do):
+    # pure rearrangement of an existing leaf: roll/where cannot produce a
+    # value the checked store didn't already admit
+    shifted = jnp.roll(q.f_cores, -1).at[-1].set(jnp.asarray(0, q.f_cores.dtype))
+    return q.replace(f_cores=jnp.where(do, shifted, q.f_cores))

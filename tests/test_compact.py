@@ -1,0 +1,334 @@
+"""Compact SoA state layout (core/compact.py): range-audited narrow storage
+must be pure data layout — bit-identical results to the wide int32 AoS
+layout across the whole parity matrix (DELAY parity/blocked/wave+trader,
+FFD, FIFO+borrowing), composed with the chunk pipeline (ragged-K boundary,
+donated state), the event-compressed driver, and the 8-device mesh; and the
+checked-narrow overflow counter must COUNT out-of-range values instead of
+letting them wrap (ARCHITECTURE.md §state layout, PARITY.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multi_cluster_simulator_tpu.core import compact as CC
+from multi_cluster_simulator_tpu.core.engine import (
+    Engine, pack_arrivals_by_tick, pack_arrivals_chunks,
+)
+from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.ops import fields as F
+from multi_cluster_simulator_tpu.ops import queues as Q
+from multi_cluster_simulator_tpu.utils.trace import total_drops
+from tests.test_pipeline import (
+    _assert_trees_equal, _bursty_arrivals, _cfg, _specs, _tc_scenarios,
+    TC_TICKS, TICK_MS,
+)
+
+
+def _assert_states_equal(wide_state, compact_state):
+    """Canonical comparison: widen the compact state and require every leaf
+    bit-equal; the overflow counters (no wide ancestor) must be zero."""
+    assert CC.overflow_total(compact_state) == 0
+    _assert_trees_equal(wide_state, CC.to_wide(compact_state))
+
+
+def _plan_is_nonvacuous(plan):
+    d = plan.describe()
+    assert d.get("queue") and d.get("run"), (
+        f"plan narrowed nothing — vacuous compact test: {d}")
+
+
+# --------------------------------------------------------------------------
+# plan derivation
+# --------------------------------------------------------------------------
+
+def test_fit_dtype_picks_smallest_covering():
+    assert CC.fit_dtype(0, 100) == "int8"
+    assert CC.fit_dtype(-2, 127) == "int8"
+    assert CC.fit_dtype(0, 128) == "int16"
+    assert CC.fit_dtype(0, 40_000) == "int32"
+    with pytest.raises(ValueError):
+        CC.fit_dtype(0, 2**31)
+
+
+def test_derived_plan_keeps_unbounded_fields_wide():
+    """Timestamps / durations / waits stay int32 by design; the audited
+    fields narrow to the stream + config bounds."""
+    cfg = _cfg()
+    arr = _bursty_arrivals()
+    plan = CC.derive_plan(cfg, _specs(3), arr)
+    qd = plan.queue_dtypes()
+    for name in ("dur", "enq_t", "rec_wait"):
+        assert qd[name] == np.dtype(np.int32), name
+    assert plan.run_dtypes()["end_t"] == np.dtype(np.int32)
+    assert qd["cores"].itemsize < 4 and qd["mem"].itemsize < 4
+    assert qd["owner"].itemsize < 4
+    assert plan.run_dtypes()["node"].itemsize < 4
+
+
+def test_plan_with_trader_widens_node_bound_to_contract_totals():
+    """A buyer's virtual node echoes the CONTRACT totals — a Level1
+    backlog cumsum, not a per-node amount (market/trader.py buyer_apply)
+    — so a trader-enabled plan must size the node dtype for
+    queue_capacity x max-demand, not the largest physical node.
+    Regression: the per-node bound let a 3-job contract total wrap the
+    int16 virtual-node capacity with the overflow counter silent."""
+    from multi_cluster_simulator_tpu.config import SimConfig, TraderConfig
+
+    cfg, arr, specs = _tc_scenarios()["delay_wave_trader"]
+    plan = CC.derive_plan(cfg, specs, arr)
+    hi = np.iinfo(plan.node_dtype()).max
+    max_demand = max(CC.audit_arrivals(arr).values())
+    assert hi >= cfg.queue_capacity * max_demand
+    # trader off: the physical-cap bound stands and node tensors narrow
+    off = SimConfig(**{**cfg.__dict__, "trader": TraderConfig(enabled=False)})
+    assert CC.derive_plan(off, specs, arr).node_dtype().itemsize < 4
+
+
+def _hot_market_case():
+    """A deterministic market run whose SECOND trade sizes a contract from
+    a deep Level1 backlog of big-memory jobs: three 14-core jobs saturate
+    the buyer's utilization (0.875 > the 0.8 request threshold), six
+    12000-mem jobs can never place on its 8000-mem nodes and promote into
+    Level1, and after the first (tiny) trade's 240 s cooldown the monitor
+    re-fires at t=250 s with a ~72000-mem backlog-cumsum contract — far
+    beyond any single node's capacity (the value the per-node storage
+    bound wrapped)."""
+    from multi_cluster_simulator_tpu.config import (
+        PolicyKind, SimConfig, TraderConfig,
+    )
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.core.state import Arrivals
+
+    cfg = SimConfig(policy=PolicyKind.DELAY, queue_capacity=64,
+                    max_running=64, max_arrivals=16, max_nodes=10,
+                    max_virtual_nodes=2, max_ingest_per_tick=16,
+                    trader=TraderConfig(enabled=True, carve_mode="sane"))
+    specs = [uniform_cluster(1, 3, cores=16, memory=8_000),
+             uniform_cluster(2, 10)]
+    t = np.array([[500, 500, 500, 600, 600, 600, 600, 600, 600],
+                  [0] * 9], np.int32)
+    cores = np.array([[14, 14, 14, 2, 2, 2, 2, 2, 2], [1] * 9], np.int32)
+    mem = np.array([[500, 500, 500] + [12_000] * 6, [1] * 9], np.int32)
+    arr = Arrivals(t=t,
+                   id=np.broadcast_to(np.arange(9, dtype=np.int32),
+                                      (2, 9)).copy(),
+                   cores=cores, mem=mem, gpu=np.zeros((2, 9), np.int32),
+                   dur=np.full((2, 9), 280_000, np.int32),
+                   n=np.array([9, 0], np.int32))
+    return cfg, specs, arr
+
+
+def test_contract_total_beyond_node_cap_stays_bit_identical():
+    """A market run whose contract totals EXCEED every physical node's
+    capacity: the buyer's virtual node must carry the full total through
+    narrow node storage and stay bit-identical to wide. Regression: the
+    per-node bound let these totals wrap the int16 node dtype at the
+    tick-exit narrow with the overflow counter silent (the sinkhorn probe
+    measurably diverged: 189229 vs 197152 placed)."""
+    cfg, specs, arr = _hot_market_case()
+    eng = Engine(cfg)
+    ref = eng.run_jit()(init_state(cfg, specs), arr, 300)
+    plan = CC.derive_plan(cfg, specs, arr)
+    out = eng.run_jit()(init_state(cfg, specs, plan=plan), arr, 300)
+    _assert_states_equal(ref, out)
+    # non-vacuity: a virtual node activated with a capacity beyond any
+    # physical node's memory — exactly the value the old bound wrapped
+    vmem = np.asarray(out.node_cap)[:, cfg.max_nodes:, 1]
+    phys_mem = int(np.asarray(out.node_cap)[:, : cfg.max_nodes, 1].max())
+    assert vmem.max() > phys_mem, (
+        "no contract total exceeded a physical node — vacuous regression "
+        f"test (vmax {vmem.max()} vs phys {phys_mem})")
+
+
+def test_node_exit_narrow_counts_instead_of_wrapping():
+    """If the node storage dtype is undersized anyway (a stale or
+    hand-built plan), the tick-exit narrow must COUNT into run.ovf, not
+    wrap the capacity (the engine's exit narrow is checked)."""
+    import dataclasses
+
+    cfg, specs, arr = _hot_market_case()
+    plan = CC.derive_plan(cfg, specs, arr)
+    # undersize the node dtype: holds the physical caps (so init_state
+    # accepts it) but not the backlog-cumsum contract totals
+    small = CC.fit_dtype(0, 24_000)
+    assert np.dtype(small).itemsize < 4
+    stale = dataclasses.replace(plan, node=small)
+    out = Engine(cfg).run_jit()(init_state(cfg, specs, plan=stale), arr,
+                                300)
+    assert total_drops(out)["narrow"] > 0, (
+        "an undersized node dtype wrapped silently instead of counting")
+
+
+def test_plan_without_stream_keeps_ids_wide():
+    """Nothing in the config bounds job ids — without an arrivals audit the
+    planner must not guess a narrow id dtype."""
+    cfg = _cfg()
+    plan = CC.derive_plan(cfg, _specs(3), arrivals=None)
+    assert plan.queue_dtypes()["id"] == np.dtype(np.int32)
+    # capacities still bound the demand fields statically
+    assert plan.queue_dtypes()["cores"].itemsize < 4
+
+
+# --------------------------------------------------------------------------
+# bit-equality across the parity matrix (the scenarios test_pipeline pins
+# the time-compression claim on: DELAY parity / blocked / wave+trader,
+# FFD, FIFO+borrowing)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(_tc_scenarios()))
+def test_compact_bit_identical_across_policy_matrix(name):
+    cfg, arr, specs = _tc_scenarios()[name]
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    eng = Engine(cfg)
+    ref, ref_series = eng.run_jit()(init_state(cfg, specs), ta, TC_TICKS)
+    plan = CC.derive_plan(cfg, specs, arr)
+    _plan_is_nonvacuous(plan)
+    out, series = eng.run_jit()(init_state(cfg, specs, plan=plan), ta,
+                                TC_TICKS)
+    _assert_states_equal(ref, out)
+    _assert_trees_equal(ref_series, series)
+    assert int(np.asarray(out.placed_total).sum()) > 0
+    assert total_drops(out)["narrow"] == 0
+
+
+@pytest.mark.parametrize("name", ["delay_parity", "fifo_borrowing"])
+def test_compact_composes_with_time_compression(name):
+    """Compact storage under the event-compressed driver still equals the
+    wide dense scan — the two bit-identity claims must hold TOGETHER."""
+    cfg, arr, specs = _tc_scenarios()[name]
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    eng = Engine(cfg)
+    ref, ref_series = eng.run_jit()(init_state(cfg, specs), ta, TC_TICKS)
+    plan = CC.derive_plan(cfg, specs, arr)
+    out, series, stats = eng.run_compressed_jit()(
+        init_state(cfg, specs, plan=plan), ta, TC_TICKS)
+    _assert_states_equal(ref, out)
+    _assert_trees_equal(ref_series, series)
+    assert int(np.asarray(stats.ticks_executed)) < TC_TICKS, \
+        "compression never leapt — vacuous compose test"
+
+
+def test_compact_chunked_across_ragged_k_boundary():
+    """Compact + the streamed chunk pipeline (ragged per-chunk K, donated
+    state, prefetch) equals the wide one-scan run across a K boundary."""
+    C = 3
+    arr = _bursty_arrivals(C)
+    cfg = _cfg()
+    chunks = [10, 10]
+    eng = Engine(cfg)
+    ta = pack_arrivals_by_tick(arr, sum(chunks), TICK_MS)
+    ref = eng.run_jit()(init_state(cfg, _specs(C)), ta, sum(chunks))
+
+    parts = pack_arrivals_chunks(arr, chunks, TICK_MS)
+    assert parts[0].rows.shape[2] != parts[1].rows.shape[2]
+    plan = CC.derive_plan(cfg, _specs(C), arr)
+    jfn = eng.run_jit(donate=True)
+    s = jax.tree.map(jnp.copy, init_state(cfg, _specs(C), plan=plan))
+    nxt = jax.device_put(parts[0])
+    for i, n in enumerate(chunks):
+        a = nxt
+        s = jfn(s, a, n)
+        if i + 1 < len(parts):
+            nxt = jax.device_put(parts[i + 1])
+    s = jax.block_until_ready(s)
+    _assert_states_equal(ref, s)
+
+
+def test_compact_sharded_bit_identical_to_local_wide():
+    """The 8-device mesh regime: compact leaves shard over the cluster axis
+    exactly like their wide ancestors (the SimState pytree prefix covers
+    both layouts), and the sharded compact run equals the local wide run."""
+    from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
+
+    C = 8
+    arr = _bursty_arrivals(C)
+    cfg = _cfg()
+    ta = pack_arrivals_by_tick(arr, 20, TICK_MS)
+    ref = Engine(cfg).run_jit()(init_state(cfg, _specs(C)), ta, 20)
+
+    plan = CC.derive_plan(cfg, _specs(C), arr)
+    sh = ShardedEngine(cfg, make_mesh(8))
+    s = sh.shard_state(init_state(cfg, _specs(C), plan=plan))
+    out = sh.run_fn(20, tick_indexed=True)(s, sh.shard_arrivals(ta))
+    out = jax.block_until_ready(out)
+    _assert_states_equal(ref, out)
+
+
+# --------------------------------------------------------------------------
+# checked-narrow overflow: count, never wrap
+# --------------------------------------------------------------------------
+
+def test_push_back_out_of_range_counts_instead_of_wrapping():
+    q = Q.empty_soa(4, {n: (np.dtype(np.int8) if n == "cores"
+                            else np.dtype(np.int32))
+                        for n in F.QUEUE_FIELDS})
+    job = Q.JobRec.make(id=1, cores=500, mem=10, dur=5, enq_t=0)
+    q2 = Q.push_back(q, job, jnp.bool_(True))
+    assert int(q2.ovf) == 1
+    # clamped to the dtype minimum (deterministic poison), not wrapped to
+    # 500 % 256 == -12
+    assert int(q2.cores[0]) == np.iinfo(np.int8).min
+    # an in-range job on the same queue adds nothing
+    q3 = Q.push_back(q2, Q.JobRec.make(id=2, cores=100), jnp.bool_(True))
+    assert int(q3.ovf) == 1
+
+
+def test_push_back_not_taken_does_not_count():
+    q = Q.empty_soa(4, {n: (np.dtype(np.int8) if n == "cores"
+                            else np.dtype(np.int32))
+                        for n in F.QUEUE_FIELDS})
+    job = Q.JobRec.make(id=1, cores=500)
+    q2 = Q.push_back(q, job, jnp.bool_(False))  # do=False: no store, no count
+    assert int(q2.ovf) == 0
+
+
+def test_quiescence_sig_sees_overflow():
+    """A narrow overflow must break the leap driver's fixed-point
+    fingerprint — an overflowing tick can never be judged quiescent and
+    leapt over (core/engine._quiescence_sig)."""
+    from multi_cluster_simulator_tpu.core.engine import _quiescence_sig
+
+    cfg = _cfg()
+    arr = _bursty_arrivals(1)
+    plan = CC.derive_plan(cfg, _specs(1), arr)
+    s = init_state(cfg, _specs(1), plan=plan)
+    sig0 = np.asarray(_quiescence_sig(s))
+    bumped = s.replace(ready=s.ready.replace(ovf=s.ready.ovf + 1))
+    assert not np.array_equal(sig0, np.asarray(_quiescence_sig(bumped)))
+
+
+# --------------------------------------------------------------------------
+# plumbing: checkpoints, donation, host accounting
+# --------------------------------------------------------------------------
+
+def test_compact_checkpoint_roundtrip(tmp_path):
+    from multi_cluster_simulator_tpu.core.checkpoint import (
+        load_state, save_state,
+    )
+
+    C = 3
+    arr = _bursty_arrivals(C)
+    cfg = _cfg()
+    plan = CC.derive_plan(cfg, _specs(C), arr)
+    ta = pack_arrivals_by_tick(arr, 20, TICK_MS)
+    eng = Engine(cfg)
+    out = eng.run_jit()(init_state(cfg, _specs(C), plan=plan), ta, 20)
+    path = str(tmp_path / "compact.ckpt")
+    save_state(out, path)
+    restored = load_state(path, init_state(cfg, _specs(C), plan=plan))
+    _assert_trees_equal(out, restored)
+    # a wide template must refuse a compact checkpoint (dtype mismatch),
+    # not silently reinterpret it
+    with pytest.raises(Exception):
+        load_state(path, init_state(cfg, _specs(C)))
+
+
+def test_state_nbytes_shrinks():
+    C = 3
+    arr = _bursty_arrivals(C)
+    cfg = _cfg()
+    plan = CC.derive_plan(cfg, _specs(C), arr)
+    wide = CC.state_nbytes(init_state(cfg, _specs(C)))
+    comp = CC.state_nbytes(init_state(cfg, _specs(C), plan=plan))
+    assert comp < wide, (comp, wide)

@@ -3,19 +3,27 @@ workloads, not just the handful of fixed seeds the targeted parity tests
 use. Each case runs the engine and the Go-semantics oracle on a fresh
 seeded workload and requires identical placement traces and queue stats
 (PARITY.md). Kept small enough for CI (~1 min warm) but spanning every
-policy and the borrowing path."""
+policy and the borrowing path.
+
+The compact-storage boundary cases at the bottom fuzz the OTHER
+bit-exactness claim (core/compact.py): streams whose audited fields sit
+exactly at the derived storage-dtype boundaries must stay bit-identical
+between the compact and wide layouts, and a value one past the audited
+boundary must fire the narrow-overflow counter instead of wrapping."""
 
 import dataclasses
 
+import jax
 import numpy as np
 import pytest
 
-from multi_cluster_simulator_tpu.config import PolicyKind, WorkloadConfig
+from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig, WorkloadConfig
+from multi_cluster_simulator_tpu.core import compact as CC
 from multi_cluster_simulator_tpu.core.engine import Engine
 from multi_cluster_simulator_tpu.core.spec import uniform_cluster
-from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.core.state import Arrivals, init_state
 from multi_cluster_simulator_tpu.oracle.go_semantics import Oracle
-from multi_cluster_simulator_tpu.utils.trace import check_conservation
+from multi_cluster_simulator_tpu.utils.trace import check_conservation, total_drops
 from tests.conftest import make_arrivals
 from tests.test_parity import (
     BASE, assert_stats_equal, assert_traces_equal, run_both,
@@ -102,3 +110,79 @@ def test_fuzz_trader_market(seed, lam, carve):
     assert_traces_equal(state, oracle, 2)
     assert_stats_equal(state, oracle, 2)
     check_conservation(state)
+
+
+# --------------------------------------------------------------------------
+# compact-storage range boundaries (core/compact.py)
+# --------------------------------------------------------------------------
+
+def _boundary_arrivals(cores_max, mem_max, id_max, dur_max, n_jobs=6):
+    """A stream whose audited maxima sit EXACTLY at the requested values:
+    the derived plan's dtypes are then exactly wide enough, and every
+    boundary value must round-trip through narrow storage unchanged."""
+    C, A = 1, n_jobs
+    t = np.arange(A, dtype=np.int32)[None, :] * 700
+    cores = np.full((C, A), 1, np.int32)
+    cores[0, 0] = cores_max  # the boundary row
+    mem = np.full((C, A), 1, np.int32)
+    mem[0, 1] = mem_max
+    ids = np.arange(A, dtype=np.int32)[None, :].copy()
+    ids[0, 2] = id_max
+    dur = np.full((C, A), 1_000, np.int32)
+    dur[0, 3] = dur_max
+    return Arrivals(t=t, id=ids, cores=cores, mem=mem,
+                    gpu=np.zeros((C, A), np.int32), dur=dur,
+                    n=np.full((C,), A, np.int32))
+
+
+def _boundary_cfg():
+    # a single huge node so every boundary job is placeable and the demand
+    # bounds come from the STREAM, not the capacities
+    return SimConfig(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                     queue_capacity=16, max_running=32, max_arrivals=8,
+                     max_ingest_per_tick=8, max_nodes=1, max_virtual_nodes=0)
+
+
+@pytest.mark.parametrize("cores_max,mem_max,id_max", [
+    (127, 127, 127),            # int8 upper edges
+    (128, 32_767, 32_767),      # int16 promotion edges
+    (32_768, 40_000, 40_000),   # int32 fallbacks
+])
+def test_fuzz_boundary_streams_bit_identical(cores_max, mem_max, id_max):
+    cfg = _boundary_cfg()
+    # capacities sit at the same boundary as the stream: the demand bound
+    # is max(stream, capacities), so a larger node would silently widen
+    # the audited dtype and make the boundary case vacuous
+    specs = [uniform_cluster(1, 1, cores=cores_max, memory=max(mem_max, 1))]
+    arr = _boundary_arrivals(cores_max, mem_max, id_max, dur_max=40_000)
+    plan = CC.derive_plan(cfg, specs, arr)
+    # the audit must have picked dtypes that hold the boundary EXACTLY
+    assert np.iinfo(plan.queue_dtypes()["cores"]).max >= cores_max
+    eng = Engine(cfg)
+    ref = eng.run_jit()(init_state(cfg, specs), arr, 40)
+    out = eng.run_jit()(init_state(cfg, specs, plan=plan), arr, 40)
+    assert total_drops(out)["narrow"] == 0
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(CC.to_wide(out))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(np.asarray(out.placed_total).sum()) > 0
+
+
+@pytest.mark.parametrize("field", ["cores", "mem", "id"])
+def test_fuzz_one_past_boundary_fires_counter(field):
+    """A value one past the audited boundary, run under the stale plan,
+    must INCREMENT the narrow-overflow counter — never silently wrap into
+    a small in-range value (the Drops contract, core/state.py)."""
+    cfg = _boundary_cfg()
+    specs = [uniform_cluster(1, 1, cores=127, memory=127)]
+    arr = _boundary_arrivals(cores_max=127, mem_max=127, id_max=127,
+                             dur_max=40_000)
+    plan = CC.derive_plan(cfg, specs, arr)
+    dt = plan.queue_dtypes()[field]
+    assert dt == np.dtype(np.int8), "fixture must derive an int8 bound"
+    hot = np.asarray(getattr(arr, field)).copy()
+    hot[0, 4] = np.iinfo(dt).max + 1  # one past the audited boundary
+    arr_past = arr.replace(**{field: hot})
+    out = Engine(cfg).run_jit()(init_state(cfg, specs, plan=plan),
+                                arr_past, 40)
+    assert total_drops(out)["narrow"] > 0, (
+        f"{field} one past the boundary did not fire the overflow counter")

@@ -67,6 +67,7 @@ FIXTURE_RULES = [
     ("bad_det_set.py", "det-unordered-iter"),
     ("bad_det_wallclock.py", "det-wallclock"),
     ("bad_det_chunk_sync.py", "det-chunk-sync"),
+    ("bad_compact_store.py", "compact-store"),
     ("bad_pragma.py", "pragma-no-reason"),
     ("bad_pragma.py", "pragma-stale"),
 ]
@@ -90,6 +91,46 @@ def test_cli_exits_nonzero_on_fixture(fixture):
 def test_rules_are_known():
     for _, rule in FIXTURE_RULES:
         assert rule in ALL_RULES
+
+
+def test_bad_compact_store_flags_every_bypass_form():
+    """The fixture carries all four bypass shapes — a literal narrow cast,
+    an unchecked f_ leaf store of a fresh name, a widened-accessor store
+    (int32 compute property into a narrow leaf), and an ad-hoc narrow
+    constructor — and each must surface as its own finding (a rule that
+    only catches one form would pass a weaker fixture)."""
+    findings = [f for f in run(str(FIXTURES / "bad_compact_store.py"))
+                if f.rule == "compact-store"]
+    assert len(findings) == 4, "\n".join(f.render() for f in findings)
+
+
+def test_good_compact_store_fixture_is_clean():
+    """The paired clean version — the same stores through narrow_store, and
+    a pure leaf rearrangement (roll/where), which needs no check — must NOT
+    trip compact-store."""
+    findings = run(str(FIXTURES / "good_compact_store.py"))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    proc = _cli(str(FIXTURES / "good_compact_store.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_compact_store_reaches_the_real_soa_ops(tmp_path):
+    """compact-store provably engages with ops/queues.py's real SoA code:
+    replace one checked store with a literal narrow cast and the rule must
+    fire — so the package analyzing clean can never mean 'checked
+    nothing'."""
+    src = (PKG_DIR / "ops" / "queues.py").read_text()
+    anchor = ("            stored, nbad = F.narrow_store(job.vec[..., _FIDX[n]], "
+              "leaf.dtype,\n                                          do=ok)\n")
+    bad = src.replace(
+        anchor,
+        "            import jax.numpy as jnp2\n"
+        "            stored = job.vec[..., _FIDX[n]].astype(jnp2.int8)\n"
+        "            nbad = 0\n", 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "queues_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "compact-store" for x in run(str(f)))
 
 
 def test_good_chunk_pipeline_fixture_is_clean():

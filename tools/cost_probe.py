@@ -5,17 +5,42 @@ For each shape this lowers one ``Engine.tick`` through XLA, pulls the
 compiler's own cost model (``compiled.cost_analysis()``: flops, bytes
 accessed), measures the real per-tick wall by timing a jitted
 ``lax.scan`` over N ticks, and derives the achieved HBM bandwidth. The
-point is the evidence behind the no-Pallas design decision (README):
-the tick is bandwidth/latency-bound small-integer work, not FLOPs —
-arithmetic intensity is far below the MXU knee, so custom kernels would
-be fighting the wrong bottleneck.
+point is twofold:
 
-Run on the TPU (the default backend): ``python tools/cost_probe.py``.
-Writes a table to stdout and JSON to tools/cost_probe.json.
+- the evidence behind the no-Pallas design decision (README): the tick is
+  bandwidth/latency-bound small-integer work, not FLOPs — arithmetic
+  intensity is far below the MXU knee, so custom kernels would be fighting
+  the wrong bottleneck;
+- the measured bytes/tick ledger for the compact SoA state layout
+  (core/compact.py, ``bench.py --compact``): each row carries a wide and a
+  compact measurement plus the reduction, so "the narrow layout cuts the
+  working set" is a number in the artifact, not an assertion.
+
+The probe measures the TICK-INDEXED tick (pre-bucketed TickArrivals scan
+inputs) — the path every scale bench config actually runs since the
+streamed-pipeline PR; the windowed due-scan path is gone from the scale
+drivers and would overstate arrival-stream bytes.
+
+First-class CLI (runs in the CI bench-smoke job in --quick form):
+
+  python -m tools.cost_probe [--out tools/cost_probe.json] [--quick]
+                             [--configs NAME ...] [--compact both|off|on]
+
+Exits nonzero on NaN/zero timings or byte counts (a roofline row that
+silently degenerates would otherwise rot in the JSON unnoticed), and — when
+both layouts are measured — on a compact layout that stops being
+byte-smaller than the wide one.
+
+The round-5 TPU record (cost-model bytes on the windowed-ingest tick, the
+pre-rewrite methodology) is preserved verbatim in
+tools/cost_probe_tpu_r05.json — README's no-Pallas roofline argument cites
+it; tools/cost_probe.json is the live record this CLI regenerates, with
+``backend``/``device`` stamped per row.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -23,14 +48,15 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
 import numpy as np
 
 
-def shapes():
+def shapes(quick=False):
     from multi_cluster_simulator_tpu.config import (
         MatchKind, PolicyKind, SimConfig, TraderConfig,
     )
+
+    scale = 16 if quick else 1
 
     # (name, cfg, C, jobs_per, full_ticks) — jobs are scaled down by
     # n_ticks/full_ticks so the probe's per-tick load density matches the
@@ -38,7 +64,7 @@ def shapes():
     yield "headline_fifo_4k", SimConfig(
         policy=PolicyKind.FIFO, queue_capacity=8, max_running=32,
         max_arrivals=250, max_ingest_per_tick=8, parity=True, n_res=2,
-        max_nodes=5, max_virtual_nodes=0), 4096, 250, 1570
+        max_nodes=5, max_virtual_nodes=0), 4096 // scale, 250, 1570
     # both FFD sweep forms, so the JSON keeps carrying the serial-vs-wave
     # evidence the wave kernel's docstring cites (the serial row is the
     # latency-bound baseline; wave is the shipping default)
@@ -46,27 +72,108 @@ def shapes():
         policy=PolicyKind.FFD, parity=False, max_placements_per_tick=16,
         queue_capacity=32, max_running=96, max_arrivals=250,
         max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0,
-        n_res=2, ffd_sweep="serial"), 4096, 250, 1600
+        n_res=2, ffd_sweep="serial"), 4096 // scale, 250, 1600
     yield "borg4k_ffd_wave", SimConfig(
         policy=PolicyKind.FFD, parity=False, max_placements_per_tick=16,
         queue_capacity=32, max_running=96, max_arrivals=250,
         max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0,
-        n_res=2, ffd_sweep="wave"), 4096, 250, 1600
+        n_res=2, ffd_sweep="wave"), 4096 // scale, 250, 1600
     yield "sinkhorn_market_4k", SimConfig(
         policy=PolicyKind.DELAY, parity=False, max_placements_per_tick=8,
         queue_capacity=256, max_running=128, max_arrivals=400,
         max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=4,
         trader=TraderConfig(enabled=True, matching=MatchKind.SINKHORN,
-                            carve_mode="sane")), 4096, 400, 700
+                            carve_mode="sane")), 4096 // scale, 400, 700
 
 
-def probe(name, cfg, C, jobs_per, full_ticks, n_ticks=200):
-    from multi_cluster_simulator_tpu.core.engine import Engine
-    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+def _cost(compiled) -> dict:
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    return cost
+
+
+def probe_layout(cfg, n_ticks, specs, arr, plan):
+    """One (shape, layout) measurement: XLA cost model of one tick-indexed
+    tick + scanned-run wall timing. ``plan=None`` is the wide layout."""
+    import jax
+
+    from multi_cluster_simulator_tpu.core.compact import state_nbytes
+    from multi_cluster_simulator_tpu.core.engine import (
+        Engine, pack_arrivals_by_tick,
+    )
     from multi_cluster_simulator_tpu.core.state import init_state
-    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
 
+    eng = Engine(cfg)
+    state = init_state(cfg, specs, plan=plan)
+    ta = pack_arrivals_by_tick(arr, n_ticks, cfg.tick_ms)
+    rows0 = jax.device_put(ta.rows[0])
+    cnt0 = jax.device_put(ta.counts[0])
+
+    def one_tick(s, rows, cnt):
+        return eng._tick(s, (rows, cnt), emit_io=False, tick_indexed=True)[0]
+
+    compiled = jax.jit(one_tick).lower(state, rows0, cnt0).compile()
+    cost = _cost(compiled)
+    flops = float(cost.get("flops", 0.0))
+    # tick_bytes_accessed is the tick executable's BUFFER-BOUNDARY traffic
+    # (argument + output bytes from the compiler's buffer assignment): the
+    # bytes of resident state + scan inputs one tick must stream, which is
+    # what the storage layout controls and what transfers across backends.
+    # The raw cost-model sum is kept alongside (xla_cost_model_bytes): on
+    # CPU it also counts the fuser's producer-duplication recomputation
+    # (cheap mask chains cloned into every per-field consumer), which
+    # overstates SoA layouts relative to real traffic; temp scratch is
+    # reported separately for the same reason.
+    cost_model_bytes = float(cost.get("bytes accessed", 0.0))
+    note = None
+    try:
+        ma = compiled.memory_analysis()
+        bytes_acc = float(ma.argument_size_in_bytes
+                          + ma.output_size_in_bytes)
+        temp_bytes = int(ma.temp_size_in_bytes)
+    except Exception as e:  # jax builds without Compiled.memory_analysis
+        bytes_acc, temp_bytes = cost_model_bytes, 0
+        note = (f"memory_analysis unavailable ({type(e).__name__}); "
+                "tick_bytes_accessed falls back to the cost-model sum")
+
+    # measured per-tick wall from the scanned run (amortizes dispatch)
+    f = eng.run_jit()
+    out = jax.block_until_ready(f(state, ta, n_ticks))
+    walls = []
+    for _ in range(3):
+        t0 = time.time()
+        out = jax.block_until_ready(f(state, ta, n_ticks))
+        walls.append(time.time() - t0)
+    per_tick_ms = min(walls) / n_ticks * 1e3
+    achieved_gbps = bytes_acc / (per_tick_ms / 1e3) / 1e9
+    intensity = flops / bytes_acc if bytes_acc else float("nan")
+    drops = total_drops(out)
+    out_row = {
+        "tick_flops": flops, "tick_bytes_accessed": bytes_acc,
+        "xla_cost_model_bytes": cost_model_bytes,
+        "tick_temp_bytes": temp_bytes,
+        "state_bytes": state_nbytes(state),
+        "arithmetic_intensity_flops_per_byte": round(intensity, 4),
+        "measured_ms_per_tick": round(per_tick_ms, 3),
+        "achieved_GB_per_s": round(achieved_gbps, 1),
+        "placed": int(np.asarray(out.placed_total).sum()),
+        "drops": drops,
+    }
+    if note is not None:
+        out_row["tick_bytes_note"] = note
+    return out_row
+
+
+def probe(name, cfg, C, jobs_per, full_ticks, n_ticks=200, compact="both"):
     import dataclasses
+
+    import jax
+
+    from multi_cluster_simulator_tpu.core.compact import derive_plan
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
 
     jobs_probe = max(int(jobs_per * n_ticks / full_ticks), 8)
     cfg = dataclasses.replace(cfg, max_arrivals=jobs_probe)
@@ -78,62 +185,127 @@ def probe(name, cfg, C, jobs_per, full_ticks, n_ticks=200):
                          max_mem=18_000, max_dur_ms=60_000, seed=7,
                          max_gpus=2 if cfg.n_res > 2 else 0,
                          gpu_frac=0.1 if cfg.n_res > 2 else 0.0)
-    eng = Engine(cfg)
-    state = init_state(cfg, specs)
-
-    # compiler cost model for ONE tick (arrivals pre-packed once, exactly
-    # as the scan path does at engine.py run())
-    from multi_cluster_simulator_tpu.core.engine import pack_arrivals
-    packed = pack_arrivals(arr)
-
-    def one_tick(s):
-        return eng._tick(s, packed, emit_io=False)[0]
-
-    lowered = jax.jit(one_tick).lower(state)
-    cost = lowered.compile().cost_analysis()
-    if isinstance(cost, list):  # older jax returns [dict]
-        cost = cost[0] if cost else {}
-    flops = float(cost.get("flops", 0.0))
-    bytes_acc = float(cost.get("bytes accessed", 0.0))
-
-    # measured per-tick wall from the scanned run (amortizes dispatch)
-    f = eng.run_jit()
-    out = jax.block_until_ready(f(state, arr, n_ticks))
-    walls = []
-    for _ in range(3):
-        t0 = time.time()
-        out = jax.block_until_ready(f(state, arr, n_ticks))
-        walls.append(time.time() - t0)
-    per_tick_ms = min(walls) / n_ticks * 1e3
-    achieved_gbps = bytes_acc / (per_tick_ms / 1e3) / 1e9
-    intensity = flops / bytes_acc if bytes_acc else float("nan")
-    return {
-        "config": name, "clusters": C, "backend": jax.default_backend(),
-        "tick_flops": flops, "tick_bytes_accessed": bytes_acc,
-        "arithmetic_intensity_flops_per_byte": round(intensity, 4),
-        "measured_ms_per_tick": round(per_tick_ms, 3),
-        "achieved_GB_per_s": round(achieved_gbps, 1),
-        "placed": int(np.asarray(out.placed_total).sum()),
-    }
+    row = {"config": name, "clusters": C, "backend": jax.default_backend(),
+           "device": jax.devices()[0].device_kind}
+    if compact != "on":
+        row.update(probe_layout(cfg, n_ticks, specs, arr, plan=None))
+    if compact != "off":
+        plan = derive_plan(cfg, specs, arr)
+        crow = probe_layout(cfg, n_ticks, specs, arr, plan=plan)
+        crow["plan"] = plan.describe()
+        if compact == "on":
+            row.update(crow)
+            row["layout"] = "compact"
+        else:
+            if row["tick_bytes_accessed"]:
+                crow["bytes_reduction"] = round(
+                    1.0 - crow["tick_bytes_accessed"]
+                    / row["tick_bytes_accessed"], 4)
+                crow["state_bytes_reduction"] = round(
+                    1.0 - crow["state_bytes"] / row["state_bytes"], 4)
+            row["compact"] = crow
+    return row
 
 
-def main():
-    rows = [probe(*s) for s in shapes()]
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "cost_probe.json")
-    with open(out, "w") as f:
+def _check(rows, compact) -> list[str]:
+    """Degenerate-measurement audit: the reasons this CLI exits nonzero."""
+    problems = []
+    for r in rows:
+        for scope, d in ((r["config"], r),
+                         (r["config"] + "[compact]", r.get("compact", {}))):
+            for k in ("measured_ms_per_tick", "tick_bytes_accessed"):
+                v = d.get(k)
+                if v is not None and (not np.isfinite(v) or v <= 0):
+                    problems.append(f"{scope}: {k} degenerate ({v})")
+            if d.get("drops") and any(d["drops"].values()):
+                problems.append(f"{scope}: nonzero drops {d['drops']}")
+        if compact == "both" and "compact" in r:
+            if r["compact"].get("placed") != r.get("placed"):
+                problems.append(
+                    f"{r['config']}: compact placed {r['compact'].get('placed')} "
+                    f"!= wide {r.get('placed')} — the layouts diverged")
+            if r["compact"]["state_bytes"] >= r["state_bytes"]:
+                problems.append(
+                    f"{r['config']}: compact state is not byte-smaller "
+                    f"({r['compact']['state_bytes']} >= {r['state_bytes']})")
+            if (r["compact"].get("tick_bytes_accessed") or 0) >= \
+                    (r.get("tick_bytes_accessed") or float("inf")):
+                problems.append(
+                    f"{r['config']}: compact tick streams MORE "
+                    "buffer-boundary bytes than wide "
+                    f"({r['compact']['tick_bytes_accessed']} >= "
+                    f"{r['tick_bytes_accessed']})")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    default_out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "cost_probe.json")
+    ap.add_argument("--out", default=default_out,
+                    help="JSON output path (default: tools/cost_probe.json)")
+    ap.add_argument("--quick", action="store_true",
+                    help="1/16-scale cluster counts + short scans — the CI "
+                         "bench-smoke variant (never write this over the "
+                         "full-scale record; use --out)")
+    ap.add_argument("--ticks", type=int, default=None,
+                    help="probe scan length (default 200; quick 50)")
+    ap.add_argument("--configs", nargs="*", default=None,
+                    help="subset of shape names (default: all)")
+    ap.add_argument("--compact", choices=("both", "off", "on"),
+                    default="both",
+                    help="state layouts to measure: wide + compact with the "
+                         "per-shape reduction (both, default), wide only "
+                         "(off), compact only (on)")
+    args = ap.parse_args(argv)
+    if args.quick and os.path.abspath(args.out) == os.path.abspath(
+            default_out):
+        # same discipline as bench.py's quick-vs-full results files: smoke
+        # shapes must never clobber the committed full-scale record
+        ap.error("--quick refuses to overwrite the full-scale record "
+                 f"({default_out}); pass an explicit --out")
+    n_ticks = args.ticks or (50 if args.quick else 200)
+
+    all_shapes = list(shapes(quick=args.quick))
+    known = [s[0] for s in all_shapes]
+    if args.configs:
+        unknown = set(args.configs) - set(known)
+        if unknown:
+            ap.error(f"unknown configs {sorted(unknown)}; known: {known}")
+        all_shapes = [s for s in all_shapes if s[0] in args.configs]
+
+    import jax
+
+    print(f"# backend={jax.default_backend()} "
+          f"device={jax.devices()[0].device_kind} "
+          f"n_devices={len(jax.devices())} jax={jax.__version__}",
+          file=sys.stderr)
+    rows = [probe(*s, n_ticks=n_ticks, compact=args.compact)
+            for s in all_shapes]
+    with open(args.out, "w") as f:
         json.dump(rows, f, indent=2)
     hdr = ("config", "ms/tick", "GFLOP/tick", "MB/tick", "FLOP/byte",
-           "achieved GB/s")
-    print(f"{hdr[0]:<20}{hdr[1]:>9}{hdr[2]:>12}{hdr[3]:>10}{hdr[4]:>11}{hdr[5]:>15}")
+           "achieved GB/s", "compact MB/tick", "bytes win")
+    print(f"{hdr[0]:<20}{hdr[1]:>9}{hdr[2]:>12}{hdr[3]:>10}{hdr[4]:>11}"
+          f"{hdr[5]:>15}{hdr[6]:>17}{hdr[7]:>11}")
     for r in rows:
-        print(f"{r['config']:<20}{r['measured_ms_per_tick']:>9}"
-              f"{r['tick_flops'] / 1e9:>12.3f}"
-              f"{r['tick_bytes_accessed'] / 1e6:>10.1f}"
-              f"{r['arithmetic_intensity_flops_per_byte']:>11}"
-              f"{r['achieved_GB_per_s']:>15}")
-    print(f"# wrote {out}")
+        c = r.get("compact", {})
+        win = (f"{c['bytes_reduction'] * 100:.1f}%"
+               if "bytes_reduction" in c else "-")
+        cmb = (f"{c['tick_bytes_accessed'] / 1e6:.1f}"
+               if c.get("tick_bytes_accessed") else "-")
+        print(f"{r['config']:<20}{r.get('measured_ms_per_tick', '-'):>9}"
+              f"{r.get('tick_flops', 0) / 1e9:>12.3f}"
+              f"{r.get('tick_bytes_accessed', 0) / 1e6:>10.1f}"
+              f"{r.get('arithmetic_intensity_flops_per_byte', '-'):>11}"
+              f"{r.get('achieved_GB_per_s', '-'):>15}"
+              f"{cmb:>17}{win:>11}")
+    print(f"# wrote {args.out}")
+    problems = _check(rows, args.compact)
+    for p in problems:
+        print(f"# PROBLEM: {p}", file=sys.stderr)
+    return 2 if problems else 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,205 @@
+"""Compact-storage pass: narrowing stores must ride the checked helpers.
+
+The compact SoA state layouts (core/compact.py) store range-audited fields
+in sub-int32 dtypes. The bit-equality contract rests on ONE discipline:
+every value that enters a narrow storage leaf goes through
+``fields.narrow_store``, which clamps + COUNTS out-of-range values into the
+layout's ``ovf`` counter instead of letting two's-complement wrap silently
+corrupt a row. A direct cast is the one-line edit that breaks the contract
+without failing any small test (the wrap only fires on boundary workloads).
+
+``compact-store`` flags, in tick-path code:
+
+- ``x.astype(jnp.int8)`` and friends — any cast whose target is a LITERAL
+  sub-int32 integer dtype (int8/int16/uint8/uint16, as a jnp/np attribute
+  or a dtype string). The sanctioned helpers take the storage dtype as a
+  *variable* (``leaf.dtype`` / the plan's table), so literal narrow casts
+  in engine/ops code are bypass smell by construction. Array constructors
+  (``jnp.asarray/array/full/zeros/ones``) with a literal narrow dtype are
+  flagged the same way.
+- ``q.replace(f_cores=EXPR)`` / ``SoAJobQueue(f_cores=EXPR, ...)`` — an
+  explicit store into a compact leaf (the ``f_`` prefix is the storage
+  namespace) whose value expression neither calls ``narrow_store`` nor
+  reuses a name bound from it in the same function, and is not a pure
+  rearrangement (roll/where/take/flip/concatenate of existing leaves,
+  which only permute already-checked values and cannot overflow).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint.callgraph import dotted_name
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+_NARROW_NAMES = frozenset({"int8", "int16", "uint8", "uint16"})
+_BLESSED = ("narrow_store",)
+# calls that only permute/select already-stored leaf values — they cannot
+# produce a value the checked store didn't already admit
+_REARRANGE = frozenset({"roll", "where", "take", "take_along_axis", "flip",
+                        "concatenate", "broadcast_to", "full", "full_like",
+                        "zeros", "zeros_like", "ones_like", "asarray",
+                        "getattr"})
+
+
+def _is_narrow_literal(expr, num_aliases: frozenset) -> bool:
+    """jnp.int8 / np.uint16 / 'int8' — a literal sub-int32 integer dtype."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value in _NARROW_NAMES
+    d = dotted_name(expr) or ""
+    parts = d.split(".")
+    return (len(parts) == 2 and parts[0] in num_aliases
+            and parts[1] in _NARROW_NAMES)
+
+
+def _narrow_cast_findings(mod: Module, num_aliases: frozenset) -> set:
+    found = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "astype":
+            args = list(node.args) + [k.value for k in node.keywords]
+            if any(_is_narrow_literal(a, num_aliases) for a in args):
+                found.add((node.lineno, "compact-store",
+                           "literal narrow-dtype cast in tick-path code: "
+                           "a direct .astype(int8/int16) bypasses the "
+                           "checked store — route the value through "
+                           "fields.narrow_store (core/compact.py), which "
+                           "counts out-of-range values into the layout's "
+                           "ovf counter instead of silently wrapping"))
+            continue
+        d = dotted_name(node.func) or ""
+        leaf = d.split(".")[-1]
+        if leaf in ("asarray", "array", "full", "zeros", "ones", "empty"):
+            args = list(node.args) + [k.value for k in node.keywords]
+            if any(_is_narrow_literal(a, num_aliases) for a in args):
+                found.add((node.lineno, "compact-store",
+                           f"array constructor `{d}` with a literal narrow "
+                           "dtype in tick-path code: build narrow storage "
+                           "from a CompactPlan's dtype table and store "
+                           "through fields.narrow_store, not ad-hoc "
+                           "narrow literals"))
+    return found
+
+
+# value-argument positions per rearranger: only these carry stored DATA
+# (the rest are masks, shifts, shapes, dtypes — static/non-stored operands)
+_VALUE_ARGS = {"where": (1, 2), "roll": (0,), "flip": (0,), "take": (0,),
+               "take_along_axis": (0,), "concatenate": (0,),
+               "broadcast_to": (0,), "asarray": (0,), "full": (1,),
+               "full_like": (1,)}
+
+
+def _bound_names(func_node, value_pred) -> set:
+    """Names bound (directly or via tuple unpack) from assignment values
+    satisfying ``value_pred``, within one function body."""
+    names: set = set()
+    for node in ast.walk(func_node):
+        if not (isinstance(node, ast.Assign) and value_pred(node.value)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                names.update(e.id for e in t.elts if isinstance(e, ast.Name))
+    return names
+
+
+def _contains_blessed(expr) -> bool:
+    return any(isinstance(c, ast.Call)
+               and (dotted_name(c.func) or "").split(".")[-1] in _BLESSED
+               for c in ast.walk(expr))
+
+
+def _value_pure(expr, pure: set) -> bool:
+    """Is a DATA expression safe to land in a narrow leaf without a check?
+    Pure = already-stored leaf content (``f_*`` attribute loads, names bound
+    from pure rearrangements, blessed-store results) moved around by
+    rearrangers that cannot synthesize new values."""
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id in pure
+    if isinstance(expr, ast.Attribute):
+        # ONLY storage-namespace loads are pure: q.f_cores (a leaf),
+        # leaf.dtype, and .at chains over a pure base. Widened accessor
+        # properties (job.cores, q.enq_t) are int32 COMPUTE values — an
+        # at[].set of one into a narrow leaf is exactly the silent-wrap
+        # bypass this rule exists to catch, so they are NOT pure.
+        if expr.attr.startswith("f_") or expr.attr == "dtype":
+            return True
+        if expr.attr == "at":
+            return _value_pure(expr.value, pure)
+        return False
+    if isinstance(expr, ast.Subscript):
+        return _value_pure(expr.value, pure)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return all(_value_pure(e, pure) for e in expr.elts)
+    if isinstance(expr, ast.Call):
+        if (dotted_name(expr.func) or "").split(".")[-1] in _BLESSED:
+            return True
+        if isinstance(expr.func, ast.Attribute) and expr.func.attr in (
+                "set", "add"):
+            # X.at[i].set(v): both the base leaf and the new value matter
+            base_ok = _value_pure(expr.func.value, pure)
+            return base_ok and all(_value_pure(a, pure) for a in expr.args)
+        if isinstance(expr.func, ast.Attribute) and expr.func.attr == "at":
+            return _value_pure(expr.func.value, pure)
+        leaf = (dotted_name(expr.func) or "").split(".")[-1]
+        if leaf in _REARRANGE:
+            idxs = _VALUE_ARGS.get(leaf, ())
+            return all(_value_pure(expr.args[i], pure)
+                       for i in idxs if i < len(expr.args))
+        return False
+    return False
+
+
+def _leaf_store_findings(mod: Module) -> set:
+    found = set()
+    for func in ast.walk(mod.tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        pure = _bound_names(func, _contains_blessed)
+        # fixed point: names bound from pure rearrangements are pure too
+        # (a = roll(q.f_x, -1); b = where(m, a, q.f_x))
+        while True:
+            more = _bound_names(func,
+                                lambda v: _value_pure(v, pure))
+            if more <= pure:
+                break
+            pure |= more
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            is_replace = (isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "replace")
+            is_ctor = (dotted_name(node.func) or "").split(".")[-1].startswith(
+                "SoA")
+            if not (is_replace or is_ctor):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None or not kw.arg.startswith("f_"):
+                    continue
+                if not (_contains_blessed(kw.value)
+                        or _value_pure(kw.value, pure)):
+                    found.add((node.lineno, "compact-store",
+                               f"store into compact leaf `{kw.arg}` bypasses "
+                               "the checked-narrow helper: derive the "
+                               "stored value via fields.narrow_store (and "
+                               "accumulate its overflow count into `ovf`) "
+                               "or keep the expression a pure "
+                               "rearrangement of existing leaves"))
+    return found
+
+
+def check_module(mod: Module) -> list[Finding]:
+    num_aliases = frozenset(
+        a for a, m in mod.module_aliases.items()
+        if m in ("numpy", "jax.numpy")) | frozenset(
+        a for a, (src, orig) in mod.from_imports.items()
+        if src == "jax" and orig == "numpy")
+    findings = _narrow_cast_findings(mod, num_aliases)
+    findings |= _leaf_store_findings(mod)
+    return [Finding(mod.path, line, rule, msg)
+            for (line, rule, msg) in sorted(findings)]

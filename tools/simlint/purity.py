@@ -34,6 +34,9 @@ from tools.simlint.project import Module
 STATIC_PARAM_NAMES = frozenset({
     "self", "cls", "cfg", "config", "mcfg", "tcfg", "wcfg", "ex", "mesh",
     "axis", "mode", "place",
+    # storage dtypes are trace-time Python values (np.dtype objects from a
+    # CompactPlan's static table — core/compact.py)
+    "dtype", "dtypes",
 })
 STATIC_ANNOTATIONS = frozenset({
     "int", "bool", "str", "float", "SimConfig", "TraderConfig",

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
-from tools.simlint import determinism, findings as F, lockset, purity
+from tools.simlint import compactstore, determinism, findings as F, lockset, purity
 from tools.simlint.callgraph import CallGraph
 from tools.simlint.project import Module, in_scope, load_target
 
@@ -22,8 +22,12 @@ PURITY_RULES = ("purity-traced-branch", "purity-wallclock",
                 "purity-host-coerce", "purity-np-call", "purity-dtype64")
 LOCKSET_RULES = ("lock-unguarded-access", "lock-holds-violation")
 DET_RULES = ("det-unordered-iter", "det-wallclock", "det-chunk-sync")
+# compact-storage discipline shares the purity scope: the SoA layouts and
+# every code path that can store into them live in the jitted tick closure
+COMPACT_RULES = ("compact-store",)
 PRAGMA_RULES = ("pragma-no-reason", "pragma-stale")
-ALL_RULES = PURITY_RULES + LOCKSET_RULES + DET_RULES + PRAGMA_RULES
+ALL_RULES = (PURITY_RULES + LOCKSET_RULES + DET_RULES + COMPACT_RULES
+             + PRAGMA_RULES)
 
 
 def run(target: str, rules: Optional[Iterable[str]] = None,
@@ -43,7 +47,9 @@ def run(target: str, rules: Optional[Iterable[str]] = None,
         if in_scope(mod, PURITY_DIRS, PURITY_EXTRA_FILES):
             raw += purity.check_module(mod, graph)
             raw += purity.check_dtype_attrs(mod, graph)
+            raw += compactstore.check_module(mod)
             checked.update(PURITY_RULES)
+            checked.update(COMPACT_RULES)
         if in_scope(mod, LOCKSET_DIRS):
             raw += lockset.check_module(mod)
             checked.update(LOCKSET_RULES)
