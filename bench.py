@@ -271,6 +271,10 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     if use_mesh and n_dev > 1 and state.arr_ptr.shape[0] % n_dev == 0:
         from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
         sh = ShardedEngine(cfg, make_mesh(n_dev))
+        # policy provenance from the engine that actually runs (registered
+        # name + param digest) — joinable with tournament rows and other
+        # BENCH_*.json rounds
+        info["policy"] = sh.engine.policy_provenance()
         state = sh.shard_state(state)
         put = sh.shard_arrivals
         if not tick_indexed:
@@ -284,6 +288,7 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
         if not tick_indexed:
             arrivals = jax.device_put(arrivals)
         eng = Engine(cfg)
+        info["policy"] = eng.policy_provenance()
         jfn = jax.jit(eng.run, static_argnums=(2,),
                       donate_argnums=(0,) if pipelined else ())
         cfn = (eng.run_compressed_jit(donate=pipelined)
@@ -432,7 +437,7 @@ def _timing_detail(info):
     for k in ("pipeline", "h2d_bytes", "arrivals_bytes",
               "peak_hbm_process_bytes", "compile_cache", "time_compress",
               "state_bytes", "tick_bytes_accessed", "tick_bytes_note",
-              "compact"):
+              "compact", "policy"):
         if info.get(k) is not None:
             out[k] = info[k]
     return out
@@ -1326,6 +1331,39 @@ def bench_sparse_bursts(quick=False):
     }
 
 
+def bench_tournament(quick=False):
+    """Policy-tournament driver (tools/tournament.py): one compiled program
+    sweeps the scheduler zoo over a (policy, seed) grid — policies are
+    parameter DATA (policies/), so compile count is independent of sweep
+    size and every cell is bit-identical to its standalone single-policy
+    run (both gated inside run_tournament; a violation raises). Full mode
+    runs the 48-variant parameter sweep x 4 seeds the serial-loop speedup
+    is measured against (the pre-zoo workflow paid one trace + one compile
+    + one H2D pipeline per variant — tools/market_ab.py); quick mode runs
+    the 8-policy built-in lineup x 2 seeds as the CI gate."""
+    from tools.tournament import (
+        DEFAULT_POLICIES, run_tournament, sweep_policies,
+    )
+
+    if quick:
+        detail = run_tournament(policies=DEFAULT_POLICIES, n_seeds=2, C=16,
+                                jobs_per=60, horizon_ms=120_000)
+    else:
+        detail = run_tournament(policies=sweep_policies(), n_seeds=4, C=8,
+                                jobs_per=56, horizon_ms=30_000,
+                                drain_ticks=40)
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tools", "tournament.json"), "w") as f:
+            json.dump(detail, f, indent=2)
+    return {
+        "metric": "policy_tournament_speedup_vs_serial_loop",
+        "value": detail["speedup_vs_serial"],
+        "unit": "x",
+        "vs_baseline": detail["speedup_vs_serial"],
+        "detail": detail,
+    }
+
+
 CONFIGS = {
     "headline": bench_headline,
     "parity_tpu": bench_parity_tpu,
@@ -1338,6 +1376,7 @@ CONFIGS = {
     "borg_replay": bench_borg_replay,
     "sparse_bursts": bench_sparse_bursts,
     "live": bench_live,
+    "tournament": bench_tournament,
 }
 
 
@@ -1368,6 +1407,10 @@ def _setup_jax(cache_dir=None, cache_enabled=True):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="headline", choices=sorted(CONFIGS))
+    ap.add_argument("--tournament", action="store_true",
+                    help="shorthand for --config tournament: one compiled "
+                         "policy-tournament over the scheduler zoo "
+                         "(tools/tournament.py)")
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true",
                     help="shrunk shapes for smoke-testing the harness")
@@ -1410,6 +1453,8 @@ def main():
                     help="disable the persistent compilation cache (every "
                          "invocation pays the full cold compile)")
     args = ap.parse_args()
+    if args.tournament:
+        args.config = "tournament"
     _setup_jax(args.compile_cache_dir, not args.no_compile_cache)
     _CKPT["path"] = args.checkpoint
     _CKPT["resume"] = args.resume
@@ -1465,14 +1510,14 @@ def main():
 
         _PIPELINE["mode"] = "on" if args.pipeline == "ab" else args.pipeline
         res = call()
-        if args.pipeline == "ab" and name not in ("parity_tpu", "live"):
+        if args.pipeline == "ab" and name not in ("parity_tpu", "live", "tournament"):
             ab_compare(res, _PIPELINE, "on", "pipeline_ab",
                        "pipelined", "unpipelined")
-        if args.time_compress == "ab" and name not in ("parity_tpu", "live"):
+        if args.time_compress == "ab" and name not in ("parity_tpu", "live", "tournament"):
             ab_compare(res, _TIME_COMPRESS, "auto", "time_compress_ab",
                        "compressed", "dense",
                        extra=("ticks_executed", "ticks_simulated"))
-        if args.compact == "ab" and name not in ("parity_tpu", "live"):
+        if args.compact == "ab" and name not in ("parity_tpu", "live", "tournament"):
 
             def compact_gates(d, doff, ab):
                 # correctness gate, not just walls: the wide re-run must

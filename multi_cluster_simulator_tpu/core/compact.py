@@ -152,6 +152,9 @@ def derive_plan(cfg: SimConfig, specs: Sequence[ClusterSpec],
         "gpu": (0, demand_hi["gpu"]),
         "owner": (-2, max(len(specs) - 1, 0)),
         "node": (-1, cfg.total_nodes - 1),
+        # schema-bounded, not stream-bounded: job_class maps any demand
+        # into [0, N_JOB_CLASSES) by construction (ops/fields.py)
+        "jclass": (0, F.N_JOB_CLASSES - 1),
     }
 
     def row_plan(names):

@@ -19,7 +19,10 @@ concurrent goroutines — see PARITY.md):
      removes them — cluster.go:65-85)
   3. arrivals with ``arr_t <= t`` enqueue (client POST /delay or /,
      server.go:22-78)
-  4. the policy's scheduling pass:
+  4. the policy's scheduling pass — dispatched through the policy zoo
+     (policies/: each policy is a batched kernel selected by a traced
+     index, its knobs a PolicyParams pytree; a singleton set folds to the
+     direct call below):
      DELAY — Level1 sweep then Level0 head + promotion (Delay(),
        scheduler.go:298-369), including in parity mode the remove-then-skip
        iteration quirk of the Level1 loop (scheduler.go:305-327)
@@ -46,21 +49,28 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+from multi_cluster_simulator_tpu.config import SimConfig
 from multi_cluster_simulator_tpu.core import state as st
-from multi_cluster_simulator_tpu.core.state import Arrivals, SimState, Trace
+from multi_cluster_simulator_tpu.core.state import Arrivals, SimState
 from multi_cluster_simulator_tpu.ops import fields as F
 from multi_cluster_simulator_tpu.ops import placement as P
 from multi_cluster_simulator_tpu.ops import queues as Q
 from multi_cluster_simulator_tpu.ops import runset as R
+from multi_cluster_simulator_tpu.policies.base import PolicySet
 
-# vmap prefix: map every per-cluster field over axis 0, broadcast the clock.
-_STATE_AXES = SimState(
-    t=None, node_cap=0, node_free=0, node_active=0, node_expire=0,
-    l0=0, l1=0, ready=0, wait=0, lent=0, borrowed=0, run=0, arr_ptr=0,
-    wait_total=0, wait_jobs=0, jobs_in_queue=0, placed_total=0, drops=0,
-    trader=0, trace=0,
+# The scheduling-pass kernels live in the policy zoo now (policies/ — PR 6,
+# policy-as-data); the engine dispatches through PolicySet and re-exports
+# the kernel names for the phase probes and older callers.
+from multi_cluster_simulator_tpu.policies.kernels import (  # noqa: F401
+    _attempt, _attempt_deferred, _delay_l0_head, _delay_local,
+    _delay_wave_local, _ffd_local, _ffd_wave_local, _fifo_drain_wave,
+    _fifo_local, _record_wait, _sweep_len, _trace_append, _trace_append_many,
+    _wave_occupy, _wave_place, _wave_probe,
 )
+
+# vmap prefix: map every per-cluster field over axis 0, broadcast the clock
+# (canonical home: core/state.py — the policy kernels share it).
+_STATE_AXES = st.STATE_AXES
 _ARR_AXES = Arrivals(t=0, id=0, cores=0, mem=0, gpu=0, dur=0, n=0)
 
 
@@ -78,81 +88,6 @@ class TickIO:
     borrow_job: jax.Array  # [C, Q.NF] i32
     ret_rows: jax.Array  # [C, max_msgs, R.RF] i32
     ret_valid: jax.Array  # [C, max_msgs] bool
-
-
-def _trace_append(tr: Trace, do, t, job_id, node, src):
-    """Per-cluster capped event append (single-cluster view)."""
-    cap = tr.t.shape[-1]
-    ok = jnp.logical_and(do, tr.n < cap)
-    i = jnp.clip(tr.n, 0, cap - 1)
-
-    def w(a, v):
-        return a.at[i].set(jnp.where(ok, v, a[i]))
-
-    return Trace(t=w(tr.t, t), job=w(tr.job, job_id), node=w(tr.node, node),
-                 src=w(tr.src, jnp.int32(src)), n=tr.n + ok.astype(jnp.int32))
-
-
-def _attempt(s: SimState, job: Q.JobRec, t, do, src, record_trace: bool):
-    """One ScheduleJob(j) attempt (scheduler.go:127-139) on a single cluster:
-    first-fit over nodes; on success occupy resources and start the job.
-
-    A full running set makes the attempt fail (job stays queued) rather than
-    leak resources — a documented divergence (PARITY.md): size
-    ``max_running`` so it never binds.
-
-    One shared body with the sweep loops: a single-row deferred buffer
-    flushed immediately (start_many of one row == start), so placement
-    accounting can never drift between the head attempts and the sweeps."""
-    n_active = jnp.sum(s.run.active).astype(jnp.int32)
-    buf = jnp.zeros((1, R.RF), jnp.int32)
-    s, success, buf, cnt = _attempt_deferred(s, job, t, do, src, record_trace,
-                                             buf, jnp.int32(0), n_active)
-    return s.replace(run=R.start_many(s.run, buf, cnt)), success
-
-
-def _attempt_deferred(s: SimState, job: Q.JobRec, t, do, src,
-                      record_trace: bool, buf, cnt, n_active):
-    """``_attempt`` for placement-sweep loops: identical semantics, but the
-    RunningSet insertion is deferred — the placed row lands in ``buf`` at
-    position ``cnt`` (a [SW, RF] scratch, SW = sweep bound) and the caller
-    flushes the batch with ``R.start_many`` after the loop. The [S]-sized
-    set is then touched once per tick instead of once per sweep step, which
-    dominated the per-tick cost at thousands of clusters. ``n_active`` is
-    the set's occupancy at loop entry; ``n_active + cnt`` reproduces the
-    sequential has-slot check exactly."""
-    node = P.first_fit(s.node_free, s.node_active, job)
-    has_slot = (n_active + cnt) < s.run.capacity
-    success = jnp.logical_and(jnp.logical_and(do, has_slot), node >= 0)
-    free = P.occupy(s.node_free, node, job, success)
-    row = R.row_from_job(job, node, t)
-    hot = jnp.logical_and(jnp.arange(buf.shape[0], dtype=jnp.int32) == cnt,
-                          success)
-    buf = jnp.where(hot[:, None], row, buf)
-    cnt = cnt + success.astype(jnp.int32)
-    trace = _trace_append(s.trace, success, t, job.id, node, src) if record_trace else s.trace
-    run_full = jnp.logical_and(jnp.logical_and(do, node >= 0),
-                               jnp.logical_not(has_slot))
-    drops = s.drops.replace(run_full=s.drops.run_full + run_full.astype(jnp.int32))
-    s = s.replace(node_free=free, trace=trace, drops=drops,
-                  placed_total=s.placed_total + success.astype(jnp.int32))
-    return s, success, buf, cnt
-
-
-def _sweep_len(cfg: SimConfig) -> int:
-    """Per-tick placement-sweep length: the whole queue in parity mode, the
-    fast-mode cap otherwise (PARITY.md §divergences)."""
-    if cfg.parity:
-        return cfg.queue_capacity
-    return min(cfg.queue_capacity, cfg.max_placements_per_tick)
-
-
-def _record_wait(total, rec_wait, enq_t, t, do):
-    """JobsMap bookkeeping on a scheduling attempt (scheduler.go:309-312):
-    TotalTime -= map[id]; map[id] = since(enqueue); TotalTime += map[id]."""
-    cur = (t - enq_t).astype(jnp.int32)
-    delta = jnp.where(do, (cur - rec_wait).astype(jnp.float32), 0.0)
-    return total + delta, jnp.where(do, cur, rec_wait)
 
 
 # --------------------------------------------------------------------------
@@ -196,7 +131,8 @@ def _quiescence_sig(state: SimState) -> jax.Array:
     return jnp.stack([p.astype(jnp.int32) for p in parts])
 
 
-def _next_event_t(state: SimState, t, cfg: SimConfig) -> jax.Array:
+def _next_event_t(state: SimState, t, cfg: SimConfig, pset: PolicySet,
+                  params) -> jax.Array:
     """Earliest future virtual time at which a quiescent constellation can
     change state again (shard-local; the driver ``allmin``s across shards
     and folds in the next nonempty arrival tick separately):
@@ -205,16 +141,23 @@ def _next_event_t(state: SimState, t, cfg: SimConfig) -> jax.Array:
       releases fire at the first tick clock >= end_t;
     - a DELAY Level0->Level1 promotion: at a fixed point the head keeps
       failing, so it promotes at the first tick clock >=
-      ``enq_t + max_wait_ms`` (scheduler.go:348-366);
+      ``enq_t + max_wait_ms`` (scheduler.go:348-366) — the threshold is the
+      policy parameter ``params.max_wait_ms`` (a traced leaf; for a
+      config-built engine it carries exactly ``cfg.max_wait_ms``), gated
+      by the traced policy index when the compiled set mixes kinds;
     - a market cadence boundary (stream snapshot / monitor round) and, in
       sane mode, a virtual-node expiry.
 
     Values are raw event times; the driver rounds up to the tick grid."""
     ev = jnp.min(jax.vmap(R.next_end_t)(state.run))
-    if cfg.policy == PolicyKind.DELAY:
+    if "delay" in pset.kinds:
         head_enq = state.l0.enq_t[:, 0]  # [C]
         promote = jnp.where(state.l0.count > 0,
-                            head_enq + jnp.int32(cfg.max_wait_ms), R.NEVER)
+                            head_enq + params.max_wait_ms.astype(jnp.int32),
+                            R.NEVER)
+        if any(k != "delay" for k in pset.kinds):
+            is_delay = pset.kind_flag_table("delay")[params.idx]
+            promote = jnp.where(is_delay, promote, R.NEVER)
         ev = jnp.minimum(ev, jnp.min(promote))
     if cfg.trader.enabled:
         from multi_cluster_simulator_tpu.market.trader import next_cadence_t
@@ -225,36 +168,8 @@ def _next_event_t(state: SimState, t, cfg: SimConfig) -> jax.Array:
     return ev
 
 
-def _leap_wait_masks_local(s: SimState, cfg: SimConfig):
-    """Queue slots whose wait clock the scheduling pass advances every tick
-    at a placement fixed point — exactly the slots the dense pass calls
-    ``_record_wait`` on when nothing places: (l0_mask, l1_mask), single
-    cluster view. FIFO records no wait in the pass, DELAY processes the
-    first ``min(|L1|, QC)`` Level1 slots plus the Level0 head, FFD the
-    first ``min(|L0|, QC)`` slots in best-fit-decreasing order."""
-    cap0 = s.l0.capacity
-    if cfg.policy == PolicyKind.FIFO:
-        z = jnp.zeros((cap0,), bool)
-        return z, jnp.zeros((s.l1.capacity,), bool)
-    QC = _sweep_len(cfg)
-    if cfg.policy == PolicyKind.DELAY:
-        l1_mask = jnp.logical_and(
-            s.l1.slot_valid(),
-            jnp.arange(s.l1.capacity, dtype=jnp.int32)
-            < jnp.minimum(s.l1.count, QC))
-        l0_mask = jnp.logical_and(
-            jnp.arange(cap0, dtype=jnp.int32) == 0, s.l0.count > 0)
-        return l0_mask, l1_mask
-    # FFD: slots selected by the first n_sweep positions of the BFD order
-    order = P.best_fit_decreasing_order(s.l0.cores, s.l0.mem, s.l0.slot_valid())
-    n_sweep = jnp.minimum(s.l0.count, QC)
-    hot = order[:, None] == jnp.arange(cap0, dtype=jnp.int32)[None, :]
-    taken = jnp.arange(cap0, dtype=jnp.int32) < n_sweep  # order positions
-    l0_mask = jnp.any(jnp.logical_and(hot, taken[:, None]), axis=0)
-    return l0_mask, jnp.zeros((s.l1.capacity,), bool)
-
-
-def _leap_local(s: SimState, new_t, do, cfg: SimConfig):
+def _leap_local(s: SimState, new_t, do, cfg: SimConfig, pset: PolicySet,
+                params):
     """Advance one cluster's wait accounting from ``s.t`` to ``new_t`` in
     closed form — the per-tick ``_record_wait`` deltas over a quiescent gap
     telescope: TotalTime -= map[id]; map[id] = since(enqueue); TotalTime +=
@@ -275,8 +190,13 @@ def _leap_local(s: SimState, new_t, do, cfg: SimConfig):
     slot in the serial sweeps); the closed form adds the telescoped sum
     once. Both are exact — hence bit-identical — while the accrued values
     are integer-valued float32 below 2^24 ms, which every parity surface
-    satisfies by orders of magnitude (PARITY.md §time compression)."""
-    l0_mask, l1_mask = _leap_wait_masks_local(s, cfg)
+    satisfies by orders of magnitude (PARITY.md §time compression).
+
+    Which slots accrue is the POLICY's business — each kernel family
+    declares its fixed-point processing set (policies/kernels.py
+    ``leap_wait_masks``) and ``pset.leap_masks`` dispatches it under the
+    same traced index as the scheduling pass."""
+    l0_mask, l1_mask = pset.leap_masks(s, cfg, params)
     l0_mask = jnp.logical_and(l0_mask, do)
     l1_mask = jnp.logical_and(l1_mask, do)
 
@@ -383,7 +303,8 @@ def pack_arrivals(arr: Arrivals) -> tuple[jax.Array, jax.Array]:
     own = jnp.full(arr.t.shape, Q.OWN, jnp.int32)
     zero = jnp.zeros(arr.t.shape, jnp.int32)
     vals = {"id": arr.id, "cores": arr.cores, "mem": arr.mem, "gpu": arr.gpu,
-            "dur": arr.dur, "enq_t": arr.t, "owner": own, "rec_wait": zero}
+            "dur": arr.dur, "enq_t": arr.t, "owner": own, "rec_wait": zero,
+            "jclass": F.job_class(arr.cores, arr.gpu)}
     rows = jnp.stack([vals[n] for n in F.QUEUE_FIELDS],
                      axis=-1).astype(jnp.int32)
     return rows, arr.n
@@ -425,7 +346,9 @@ def _bucket_arrivals_host(arr: Arrivals, n_ticks: int, tick_ms: int):
             "mem": np.asarray(arr.mem), "gpu": np.asarray(arr.gpu),
             "dur": np.asarray(arr.dur), "enq_t": t,
             "owner": np.full_like(t, int(Q.OWN)),
-            "rec_wait": np.zeros_like(t)}
+            "rec_wait": np.zeros_like(t),
+            "jclass": F.job_class(np.asarray(arr.cores),
+                                  np.asarray(arr.gpu)).astype(np.int32)}
     fields = np.stack([vals[n] for n in F.QUEUE_FIELDS], axis=-1)  # [C, A, NF]
     return fields, dest, ok, rank, counts2d.T[:n_ticks].copy()
 
@@ -579,565 +502,6 @@ def _ingest_local(s: SimState, arr_rows: jax.Array, arr_n: jax.Array, t,
     return s.replace(arr_ptr=s.arr_ptr + n)
 
 
-# --------------------------------------------------------------------------
-# phase 4: scheduling passes
-# --------------------------------------------------------------------------
-
-def _delay_local(s: SimState, t, cfg: SimConfig):
-    """Delay() — the reference's live algorithm (scheduler.go:298-369).
-
-    In fast mode (parity=False) the Level1 sweep attempts only the first
-    ``max_placements_per_tick`` queue slots — a throughput knob for scale
-    configs (PARITY.md §divergences); the queue still drains in FIFO order
-    via compaction."""
-    QC = cfg.queue_capacity if cfg.parity else min(
-        cfg.queue_capacity, cfg.max_placements_per_tick)
-
-    # ---- Level1 sweep: a bounded while loop — under vmap it runs only
-    # max-over-clusters(|Level1|) iterations, so an idle constellation pays
-    # ~nothing and parity mode costs the same as the capped fast mode.
-    # RunningSet insertions are deferred to one start_many after the loop
-    # (_attempt_deferred) — the per-step body touches only [SW]-sized
-    # scratch, not the [S]-sized set ----
-    n_sweep = jnp.minimum(s.l1.count, QC)
-    n_active = jnp.sum(s.run.active).astype(jnp.int32)
-
-    def cond(carry):
-        s2, i, rec, placed, skip_next, buf, cnt = carry
-        return i < n_sweep
-
-    def step(carry):
-        s2, i, rec, placed, skip_next, buf, cnt = carry
-        process = jnp.logical_and(i < n_sweep, jnp.logical_not(skip_next))
-        # one-hot slot access: dynamic row gathers/scatters serialize when
-        # the loop body is vmapped over thousands of clusters
-        hot = jnp.arange(s2.l1.capacity, dtype=jnp.int32) == i
-        rec_i = jnp.einsum("q,q->", hot.astype(jnp.int32), rec)
-        job = Q.select_row(s2.l1, hot).with_(rec_wait=rec_i)
-        total, new_rec = _record_wait(s2.wait_total, rec_i, job.enq_t, t, process)
-        rec = jnp.where(jnp.logical_and(hot, process), new_rec, rec)
-        s2 = s2.replace(wait_total=total)
-        s2, success, buf, cnt = _attempt_deferred(
-            s2, job, t, process, st.SRC_L1, cfg.record_trace, buf, cnt, n_active)
-        s2 = s2.replace(jobs_in_queue=s2.jobs_in_queue - success.astype(jnp.int32))
-        placed = jnp.logical_or(placed, jnp.logical_and(hot, success))
-        # Parity: Go removes L1[i] in place and `i++` skips the element that
-        # slides into position i (scheduler.go:319) — equivalent on the
-        # original order to "after a success, skip the next element".
-        skip_next = success if cfg.parity else jnp.zeros((), bool)
-        return (s2, i + 1, rec, placed, skip_next, buf, cnt)
-
-    init = (s, jnp.int32(0), s.l1.rec_wait,
-            jnp.zeros((cfg.queue_capacity,), bool), jnp.zeros((), bool),
-            jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0))
-    t_in = s.t
-    s, _, rec, placed, _, buf, cnt = jax.lax.while_loop(cond, step, init)
-    # the loop never writes the clock, but under vmap a batched loop
-    # predicate makes older jax batching rules batch EVERY carry leaf —
-    # including the replicated scalar t, which then trips the engine's
-    # out_axes=None spec. Restoring the pre-loop leaf is a semantic no-op
-    # that keeps t replicated on every jax version.
-    s = s.replace(t=t_in)
-    l1 = Q.compact(Q.set_field(s.l1, "rec_wait", rec), jnp.logical_not(placed))
-    s = s.replace(l1=l1, run=R.start_many(s.run, buf, cnt))
-    return _delay_l0_head(s, t, cfg)
-
-
-def _delay_l0_head(s: SimState, t, cfg: SimConfig):
-    """The Level0-head half of Delay() (scheduler.go:332-366): one
-    placement attempt on the head, else promote to Level1 after
-    MaxWaitTime. Shared by the serial and wave Level1 sweeps."""
-    process = s.l0.count > 0
-    job = Q.head(s.l0)
-    total, new_rec = _record_wait(s.wait_total, job.rec_wait, job.enq_t, t, process)
-    l0 = Q.set_field_elem(s.l0, "rec_wait", 0, new_rec)
-    s = s.replace(wait_total=total, l0=l0)
-    job = job.with_(rec_wait=new_rec)
-    s, success = _attempt(s, job, t, process, st.SRC_L0, cfg.record_trace)
-    s = s.replace(jobs_in_queue=s.jobs_in_queue - success.astype(jnp.int32))
-    promote = jnp.logical_and(
-        jnp.logical_and(process, jnp.logical_not(success)),
-        (t - job.enq_t) >= cfg.max_wait_ms,
-    )
-    s = s.replace(
-        l0=Q.pop_front(s.l0, jnp.logical_or(success, promote)),
-        l1=Q.push_back(s.l1, job, promote),
-        drops=s.drops.replace(
-            queue=s.drops.queue + Q.push_back_dropped(s.l1, promote)),
-    )
-    return s
-
-
-def _delay_wave_local(s: SimState, t, cfg: SimConfig):
-    """Fast-mode Delay(): the Level1 sweep as speculative waves
-    (``_wave_place``; equivalence argument in ``_ffd_wave_local``) plus
-    the shared Level0-head attempt. Parity mode keeps the serial sweep —
-    its remove-then-skip quirk and ordered float wait accumulation are
-    part of bit-parity (PARITY.md)."""
-    QC = min(cfg.queue_capacity, cfg.max_placements_per_tick)
-    n_sweep = jnp.minimum(s.l1.count, QC)
-    n_active = jnp.sum(s.run.active).astype(jnp.int32)
-    act0 = jnp.arange(QC, dtype=jnp.int32) < n_sweep
-    rows = Q.rows_prefix(s.l1, QC)  # sweep order == queue order (no sort)
-    jobs = Q.JobRec(vec=rows)
-
-    # wait accounting, vectorized over the processed prefix (fast mode:
-    # no serial-float-order constraint)
-    processed_slot = s.l1.slot_valid() & (
-        jnp.arange(s.l1.capacity, dtype=jnp.int32) < n_sweep)
-    cur = (t - s.l1.enq_t).astype(jnp.int32)
-    frec = s.l1.rec_wait
-    delta = jnp.where(processed_slot, (cur - frec).astype(jnp.float32), 0.0)
-    l1 = Q.set_field(s.l1, "rec_wait", jnp.where(processed_slot, cur, frec))
-    s = s.replace(wait_total=s.wait_total + delta.sum(), l1=l1)
-
-    free, node_sel, cnt, run_full = _wave_place(
-        s.node_free, s.node_active, s.run.capacity, n_active, jobs, act0)
-
-    placed_pos = node_sel >= jnp.int32(0)
-    all_rows = jax.vmap(lambda v, n: R.row_from_job(Q.JobRec(vec=v), n, t)
-                        )(rows, node_sel)
-    rankp = jnp.cumsum(placed_pos.astype(jnp.int32)) - 1
-    bhot = jnp.logical_and(
-        placed_pos[:, None],
-        rankp[:, None] == jnp.arange(QC, dtype=jnp.int32)[None, :],
-    ).astype(jnp.int32)
-    buf = jnp.einsum("kb,kf->bf", bhot, all_rows)
-    trace = s.trace
-    if cfg.record_trace:
-        trace = _trace_append_many(trace, placed_pos, t, jobs.id, node_sel,
-                                   st.SRC_L1)
-    placed_slot = jnp.pad(placed_pos, (0, s.l1.capacity - QC))
-    s = s.replace(
-        node_free=free, trace=trace,
-        drops=s.drops.replace(run_full=s.drops.run_full + run_full),
-        placed_total=s.placed_total + cnt,
-        jobs_in_queue=s.jobs_in_queue - cnt,
-        l1=Q.compact(s.l1, jnp.logical_not(placed_slot)),
-        run=R.start_many(s.run, buf, cnt))
-    return _delay_l0_head(s, t, cfg)
-
-
-def _ffd_local(s: SimState, t, cfg: SimConfig):
-    """First-fit-decreasing bin-pack over Level0 — one XLA sort + the shared
-    placement sweep. Not in the reference; BASELINE.json config 3. Fast mode
-    caps the sweep at ``max_placements_per_tick`` (largest jobs first)."""
-    QC = cfg.queue_capacity if cfg.parity else min(
-        cfg.queue_capacity, cfg.max_placements_per_tick)
-    order = P.best_fit_decreasing_order(s.l0.cores, s.l0.mem, s.l0.slot_valid())
-    n_sweep = jnp.minimum(s.l0.count, QC)  # order puts valid slots first
-    n_active = jnp.sum(s.run.active).astype(jnp.int32)
-
-    def cond(carry):
-        s2, k, placed, buf, cnt = carry
-        return k < n_sweep
-
-    def step(carry):
-        s2, k, placed, buf, cnt = carry
-        process = k < n_sweep
-        # one-hot slot access (see _delay_local): i = order[k], then row i
-        cap = s2.l0.capacity
-        hot_k = jnp.arange(cap, dtype=jnp.int32) == k
-        i = jnp.einsum("q,q->", hot_k.astype(jnp.int32), order)
-        hot = jnp.arange(cap, dtype=jnp.int32) == i
-        job = Q.select_row(s2.l0, hot)
-        total, new_rec = _record_wait(s2.wait_total, job.rec_wait, job.enq_t, t, process)
-        frec = s2.l0.rec_wait
-        frec = jnp.where(jnp.logical_and(hot, process), new_rec, frec)
-        s2 = s2.replace(wait_total=total,
-                        l0=Q.set_field(s2.l0, "rec_wait", frec))
-        s2, success, buf, cnt = _attempt_deferred(
-            s2, job, t, process, st.SRC_L0, cfg.record_trace, buf, cnt, n_active)
-        s2 = s2.replace(jobs_in_queue=s2.jobs_in_queue - success.astype(jnp.int32))
-        placed = jnp.logical_or(placed, jnp.logical_and(hot, success))
-        return (s2, k + 1, placed, buf, cnt)
-
-    t_in = s.t
-    s, _, placed, buf, cnt = jax.lax.while_loop(
-        cond, step, (s, jnp.int32(0), jnp.zeros((cfg.queue_capacity,), bool),
-                     jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0)))
-    # keep the replicated clock out of the batched carry (see _delay_local)
-    s = s.replace(t=t_in)
-    return s.replace(l0=Q.compact(s.l0, jnp.logical_not(placed)),
-                     run=R.start_many(s.run, buf, cnt))
-
-
-def _trace_append_many(tr, take, t, job_ids, nodes, src):
-    """Batch form of ``_trace_append``: append events for positions where
-    ``take``, in position order — bit-identical to appending them one by
-    one. One [K, cap] one-hot contraction instead of K cursor writes."""
-    cap = tr.t.shape[-1]
-    rank = jnp.cumsum(take.astype(jnp.int32)) - 1
-    idx = tr.n + rank
-    ok = jnp.logical_and(take, idx < cap)
-    hot = jnp.logical_and(
-        ok[:, None], idx[:, None] == jnp.arange(cap, dtype=jnp.int32)[None, :]
-    ).astype(jnp.int32)  # [K, cap]
-    untouched = hot.sum(axis=0) == 0  # [cap]
-
-    def w(a, vals):
-        return jnp.where(untouched, a, jnp.einsum("kc,k->c", hot,
-                                                  vals.astype(jnp.int32)))
-
-    src_v = jnp.full(take.shape, jnp.int32(src))
-    t_v = jnp.full(take.shape, jnp.asarray(t, jnp.int32))
-    return tr.replace(t=w(tr.t, t_v), job=w(tr.job, job_ids),
-                      node=w(tr.node, nodes), src=w(tr.src, src_v),
-                      n=tr.n + ok.sum().astype(jnp.int32))
-
-
-def _wave_probe(free, node_active, jobs: Q.JobRec, active):
-    """The per-wave feasibility core shared by every speculative sweep
-    (``_wave_place``, ``_fifo_drain_wave``): first-fit target selection and
-    cumulative-overflow detection for the active rows under the current
-    ``free``. This is the equivalence-critical logic — any edit here changes
-    all wave forms together (tests/test_kernel_equiv.py pins wave==serial).
-
-    A wave accepts *whole same-target groups*, not just distinct targets:
-    for jobs targeting the same node, the running group total (job k's own
-    demand plus all earlier same-target rows) is compared against the
-    node's free vector, and only the row that overflows it (and everything
-    after, via the callers' prefix rules) defers to the next wave. This is
-    exact by the same monotonicity argument as the original
-    distinct-target rule (``_ffd_wave_local`` docstring), extended one
-    step: for an accepted job k targeting node n, earlier accepted jobs on
-    other nodes leave n untouched, earlier accepted jobs ON n are exactly
-    k's group predecessors — whose total including k fits — so when the
-    serial sweep reaches k, nodes before n are still infeasible (free only
-    shrinks) and n is still feasible: the serial sweep picks n too. Without
-    the group rule, homogeneous clusters degrade to one placement per wave
-    (every queued job first-fits the same node), which left the FIFO
-    headline latency-bound at ~backlog iterations per tick.
-
-    Returns ``(feas_any, tgt, tgt_hot, overflow)``: per-row feasibility,
-    first-fit node index, its one-hot [QC, N] form (zero rows where
-    infeasible/inactive), and whether the row's cumulative group demand
-    overflows its target's free capacity this wave."""
-    feas = jax.vmap(lambda c, m, g: P.feasible(
-        free, node_active, c, m, g))(jobs.cores, jobs.mem, jobs.gpu)
-    feas = jnp.logical_and(feas, active[:, None])  # [QC, N]
-    feas_any = jnp.any(feas, axis=-1)
-    tgt = jnp.argmax(feas, axis=-1).astype(jnp.int32)  # first-fit node
-    tgt_hot = jnp.logical_and(
-        feas_any[:, None],
-        tgt[:, None] == jnp.arange(feas.shape[1],
-                                   dtype=jnp.int32)[None, :],
-    ).astype(jnp.int32)
-    res = jobs.res[..., : free.shape[-1]]  # [QC, R]
-    cum = jnp.cumsum(tgt_hot[:, :, None] * res[:, None, :], axis=0)  # [QC, N, R]
-    group_dem = jnp.einsum("kn,knr->kr", tgt_hot, cum)  # incl. the row itself
-    tgt_free = jnp.einsum("kn,nr->kr", tgt_hot, free)
-    overflow = jnp.logical_and(feas_any,
-                               jnp.any(group_dem > tgt_free, axis=-1))
-    return feas_any, tgt, tgt_hot, overflow
-
-
-def _wave_occupy(free, tgt_hot, place, jobs: Q.JobRec):
-    """Subtract the accepted rows' resources from ``free``: one [QC, N] x
-    [QC, R] contraction instead of per-row scatter-subtracts."""
-    used = jnp.einsum("kn,kr->nr", tgt_hot * place[:, None].astype(jnp.int32),
-                      jobs.res[..., : free.shape[-1]])
-    return free - used
-
-
-def _wave_place(free0, node_active, run_cap, n_active, jobs: Q.JobRec, act0):
-    """The wave-placement core shared by the FFD and DELAY fast-mode
-    sweeps: place ``jobs`` (a [QC]-batched JobRec in sweep order, active
-    where ``act0``) by speculative conflict-free-prefix waves. Returns
-    ``(free', node_sel, cnt, run_full)`` with ``node_sel[k]`` the placed
-    node per position (NO_NODE where unplaced). Equivalence argument:
-    ``_ffd_wave_local`` docstring."""
-    QC = act0.shape[0]
-
-    def cond(carry):
-        free, resolved, node_sel, cnt, run_full = carry
-        return jnp.any(jnp.logical_and(act0, jnp.logical_not(resolved)))
-
-    def step(carry):
-        free, resolved, node_sel, cnt, run_full = carry
-        active = jnp.logical_and(act0, jnp.logical_not(resolved))
-        feas_any, tgt, tgt_hot, overflow = _wave_probe(free, node_active,
-                                                       jobs, active)
-        blocked = jnp.cumsum(overflow.astype(jnp.int32)) > 0  # self included
-        place_try = jnp.logical_and(feas_any, jnp.logical_not(blocked))
-        rank = jnp.cumsum(place_try.astype(jnp.int32)) - 1
-        has_slot = (n_active + cnt + rank) < run_cap
-        place = jnp.logical_and(place_try, has_slot)
-        slot_full = jnp.logical_and(place_try, jnp.logical_not(has_slot))
-        # infeasible-now is infeasible-forever (free only shrinks): resolve
-        # failed even past the block point; slot-exhausted jobs resolve too
-        # (run_full drop), exactly as the serial sweep counts them
-        resolved = jnp.logical_or(
-            resolved, jnp.logical_or(
-                place, jnp.logical_or(
-                    slot_full,
-                    jnp.logical_and(active, jnp.logical_not(feas_any)))))
-        free = _wave_occupy(free, tgt_hot, place, jobs)
-        node_sel = jnp.where(place, tgt, node_sel)
-        cnt = cnt + place.sum().astype(jnp.int32)
-        run_full = run_full + slot_full.sum().astype(jnp.int32)
-        return free, resolved, node_sel, cnt, run_full
-
-    free, _, node_sel, cnt, run_full = jax.lax.while_loop(
-        cond, step, (free0, jnp.logical_not(act0),
-                     jnp.full((QC,), P.NO_NODE), jnp.int32(0), jnp.int32(0)))
-    return free, node_sel, cnt, run_full
-
-
-def _ffd_wave_local(s: SimState, t, cfg: SimConfig):
-    """``_ffd_local`` restructured as speculative placement waves — same
-    placements, a fraction of the serial steps.
-
-    Sequential first-fit has a loop-carried dependency (each placement
-    shrinks ``free`` for the next job), which on TPU costs one
-    latency-bound while_loop iteration per queued job, maxed over all
-    vmapped clusters (tools/cost_probe.json: the FFD sweep achieves less
-    than half the headline's HBM bandwidth). The wave form places many
-    jobs per iteration and is *provably identical* to the serial sweep:
-
-    each wave, every unresolved job computes its first-fit target under
-    the current ``free``; the accepted set is the longest prefix (in FFD
-    order) in which every job's cumulative same-target group demand fits
-    its target node (``_wave_probe`` — whole groups land in one wave).
-    For an accepted job, earlier accepted jobs on other nodes leave its
-    target untouched, earlier accepted jobs on the SAME node are its
-    group predecessors whose total including it fits, and ``free`` only
-    ever shrinks — so nodes before its target stay infeasible and its
-    target stays feasible: exactly the node the serial sweep would pick.
-    A job infeasible under the current ``free`` is infeasible forever
-    (monotonicity) and resolves as failed immediately; the first
-    group-capacity overflow defers itself and everything after it to the
-    next wave. The earliest unresolved job can never overflow (it is
-    feasible and heads its group), so every wave makes progress and the
-    loop runs one iteration per capacity epoch instead of one per job.
-
-    Used in fast mode (``parity=False`` — the Go reference has no FFD, so
-    there is no Go-semantics constraint either way; ``ffd_sweep="serial"``
-    keeps the old path, and tests/test_kernel_equiv.py pins wave == serial
-    on trace, queue, and node state across seeds)."""
-    QC = min(cfg.queue_capacity, cfg.max_placements_per_tick)
-    cap_q = s.l0.capacity
-    order = P.best_fit_decreasing_order(s.l0.cores, s.l0.mem,
-                                        s.l0.slot_valid())[:QC]  # [QC]
-    n_sweep = jnp.minimum(s.l0.count, QC)
-    n_active = jnp.sum(s.run.active).astype(jnp.int32)
-    act0 = jnp.arange(QC, dtype=jnp.int32) < n_sweep
-
-    # ordered job rows: one [QC, Q] @ [Q, NF] integer contraction
-    sel = (order[:, None] ==
-           jnp.arange(cap_q, dtype=jnp.int32)[None, :]).astype(jnp.int32)
-    rows = Q.gather_rows(s.l0, sel)
-    jobs = Q.JobRec(vec=rows)
-
-    # wait accounting, vectorized at the slot level (every processed job is
-    # recorded exactly once per tick; fast mode has no serial-float-order
-    # constraint — parity mode keeps the serial sweep)
-    processed_slot = jnp.einsum("kq,k->q", sel, act0.astype(jnp.int32)) > 0
-    cur = (t - s.l0.enq_t).astype(jnp.int32)
-    frec = s.l0.rec_wait
-    delta = jnp.where(processed_slot, (cur - frec).astype(jnp.float32), 0.0)
-    l0 = Q.set_field(s.l0, "rec_wait", jnp.where(processed_slot, cur, frec))
-    s = s.replace(wait_total=s.wait_total + delta.sum(), l0=l0)
-
-    free, node_sel, cnt, run_full = _wave_place(
-        s.node_free, s.node_active, s.run.capacity, n_active, jobs, act0)
-
-    placed_pos = node_sel >= jnp.int32(0)  # [QC], in FFD order
-    # runset rows in position order, compacted to the buffer prefix
-    all_rows = jax.vmap(lambda v, n: R.row_from_job(Q.JobRec(vec=v), n, t)
-                        )(rows, node_sel)
-    rankp = jnp.cumsum(placed_pos.astype(jnp.int32)) - 1
-    bhot = jnp.logical_and(
-        placed_pos[:, None],
-        rankp[:, None] == jnp.arange(QC, dtype=jnp.int32)[None, :],
-    ).astype(jnp.int32)  # [QC, QC]
-    buf = jnp.einsum("kb,kf->bf", bhot, all_rows)
-    trace = s.trace
-    if cfg.record_trace:
-        trace = _trace_append_many(trace, placed_pos, t, jobs.id, node_sel,
-                                   st.SRC_L0)
-    placed_slot = jnp.einsum("kq,k->q", sel, placed_pos.astype(jnp.int32)) > 0
-    return s.replace(
-        node_free=free, trace=trace,
-        drops=s.drops.replace(run_full=s.drops.run_full + run_full),
-        placed_total=s.placed_total + cnt,
-        jobs_in_queue=s.jobs_in_queue - cnt,
-        l0=Q.compact(s.l0, jnp.logical_not(placed_slot)),
-        run=R.start_many(s.run, buf, cnt))
-
-
-def _fifo_drain_wave(s: SimState, t, cfg: SimConfig, wait_active, n_active,
-                     QC: int):
-    """The FIFO ready drain (place from the head until the first failure)
-    as speculative waves — same outcome as the serial loop in
-    ``_fifo_local``, a fraction of the while_loop iterations.
-
-    The equivalence argument mirrors ``_ffd_wave_local`` (prefix-restricted
-    group acceptance via ``_wave_probe``; free only shrinks, so accepted
-    first-fit targets and observed infeasibilities are both stable), with
-    one extra rule for the drain-stops-at-first-failure semantics: each
-    wave accepts candidates only up to the first *breaker* — a group
-    capacity overflow (defer to the next wave), an infeasible job, or a
-    run-slot-exhausted job (both of the latter ARE the drain's failing
-    job: it pops to the wait queue and the drain stops). Unlike the FFD
-    sweep this is exact in parity mode too — the drain body performs no
-    order-sensitive float accumulation (wait recording happens at the
-    wait-head attempt, not here)."""
-    ready = s.ready
-    n_sweep = jnp.where(wait_active, 0,
-                        jnp.minimum(ready.count, QC)).astype(jnp.int32)
-    pos = jnp.arange(QC, dtype=jnp.int32)
-    act0 = pos < n_sweep
-    rows = Q.rows_prefix(ready, QC)  # queue order: position == slot
-    jobs = Q.JobRec(vec=rows)
-
-    def cond(carry):
-        free, resolved, node_sel, cnt, run_full, stopped, fail_idx = carry
-        return jnp.logical_and(
-            jnp.logical_not(stopped),
-            jnp.any(jnp.logical_and(act0, jnp.logical_not(resolved))))
-
-    def step(carry):
-        free, resolved, node_sel, cnt, run_full, stopped, fail_idx = carry
-        active = jnp.logical_and(act0, jnp.logical_not(resolved))
-        feas_any, tgt, tgt_hot, overflow = _wave_probe(free, s.node_active,
-                                                       jobs, active)
-        infeas = jnp.logical_and(active, jnp.logical_not(feas_any))
-        cand = jnp.logical_and(feas_any, jnp.logical_not(overflow))
-        r = jnp.cumsum(cand.astype(jnp.int32)) - cand.astype(jnp.int32)
-        cap_left = s.run.capacity - n_active - cnt
-        slotviol = jnp.logical_and(cand, r >= cap_left)
-        breaker = jnp.logical_or(overflow, jnp.logical_or(infeas, slotviol))
-        # positions strictly before the first breaker
-        before_break = jnp.cumsum(breaker.astype(jnp.int32)) == 0
-        place = jnp.logical_and(cand, before_break)
-        any_break = jnp.any(breaker)
-        b = jnp.argmax(breaker).astype(jnp.int32)  # first breaker position
-        b_hot = jnp.logical_and(pos == b, any_break)
-        failed = jnp.logical_and(
-            any_break,
-            jnp.logical_or(jnp.any(jnp.logical_and(b_hot, infeas)),
-                           jnp.any(jnp.logical_and(b_hot, slotviol))))
-        run_full = run_full + jnp.any(
-            jnp.logical_and(b_hot, slotviol)).astype(jnp.int32)
-        resolved = jnp.logical_or(resolved,
-                                  jnp.logical_or(place,
-                                                 jnp.logical_and(b_hot, failed)))
-        free = _wave_occupy(free, tgt_hot, place, jobs)
-        node_sel = jnp.where(place, tgt, node_sel)
-        cnt = cnt + place.sum().astype(jnp.int32)
-        stopped = jnp.logical_or(stopped, failed)
-        fail_idx = jnp.where(failed, b, fail_idx)
-        return free, resolved, node_sel, cnt, run_full, stopped, fail_idx
-
-    free, resolved, node_sel, cnt, run_full, stopped, fail_idx = \
-        jax.lax.while_loop(cond, step, (
-            s.node_free, jnp.logical_not(act0), jnp.full((QC,), P.NO_NODE),
-            jnp.int32(0), jnp.int32(0), jnp.zeros((), bool), jnp.int32(-1)))
-
-    placed_pos = node_sel >= jnp.int32(0)
-    n_taken = cnt + stopped.astype(jnp.int32)  # pops include the failure
-    fhot = (pos == fail_idx).astype(jnp.int32)
-    fail_job = Q.JobRec(vec=jnp.einsum("k,kf->f", fhot, rows))
-    all_rows = jax.vmap(lambda v, n: R.row_from_job(Q.JobRec(vec=v), n, t)
-                        )(rows, node_sel)
-    rankp = jnp.cumsum(placed_pos.astype(jnp.int32)) - 1
-    bhot = jnp.logical_and(
-        placed_pos[:, None],
-        rankp[:, None] == jnp.arange(QC, dtype=jnp.int32)[None, :],
-    ).astype(jnp.int32)
-    buf = jnp.einsum("kb,kf->bf", bhot, all_rows)
-    trace = s.trace
-    if cfg.record_trace:
-        trace = _trace_append_many(trace, placed_pos, t, jobs.id, node_sel,
-                                   st.SRC_READY)
-    s = s.replace(node_free=free, trace=trace,
-                  drops=s.drops.replace(run_full=s.drops.run_full + run_full),
-                  placed_total=s.placed_total + cnt)
-    return s, n_taken, fail_job, stopped, buf, cnt
-
-
-def _fifo_local(s: SimState, t, cfg: SimConfig):
-    """Fifo() (scheduler.go:216-296) as ordered masked phases; see PARITY.md
-    for the derivation of the per-tick semantics from the Go loop's
-    sleep/continue structure. Returns (state, borrow_want, borrow_job).
-
-    Fast mode (parity=False) caps the ready drain at
-    ``max_placements_per_tick`` steps — identical semantics whenever fewer
-    than that many jobs would drain in one tick (PARITY.md §divergences)."""
-    QC = cfg.queue_capacity if cfg.parity else min(
-        cfg.queue_capacity, cfg.max_placements_per_tick)
-    wait_active = s.wait.count > 0
-
-    # ---- ready drain (only when the wait queue is empty): place from the
-    # head until the first failure; the failing job moves to WaitQueue.
-    # Bounded while loop — exits as soon as every cluster drained/stopped ----
-    n_active = jnp.sum(s.run.active).astype(jnp.int32)
-
-    def dcond(carry):
-        s2, i, stopped, n_taken, fail_job, any_fail, buf, cnt = carry
-        return jnp.logical_and(
-            jnp.logical_not(wait_active),
-            jnp.logical_and(i < jnp.minimum(s2.ready.count, QC),
-                            jnp.logical_not(stopped)))
-
-    def dstep(carry):
-        s2, i, stopped, n_taken, fail_job, any_fail, buf, cnt = carry
-        process = jnp.logical_and(
-            jnp.logical_not(wait_active),
-            jnp.logical_and(i < jnp.minimum(s2.ready.count, QC),
-                            jnp.logical_not(stopped)))
-        hot = jnp.arange(s2.ready.capacity, dtype=jnp.int32) == i
-        job = Q.select_row(s2.ready, hot)
-        s2, success, buf, cnt = _attempt_deferred(
-            s2, job, t, process, st.SRC_READY, cfg.record_trace, buf, cnt,
-            n_active)
-        fail = jnp.logical_and(process, jnp.logical_not(success))
-        n_taken = n_taken + process.astype(jnp.int32)  # pops regardless of outcome
-        fail_job = jax.tree.map(lambda a, b: jnp.where(fail, b, a), fail_job, job)
-        return (s2, i + 1, jnp.logical_or(stopped, fail), n_taken, fail_job,
-                jnp.logical_or(any_fail, fail), buf, cnt)
-
-    if cfg.fifo_drain == "wave":
-        s, n_taken, fail_job, any_fail, buf, cnt = _fifo_drain_wave(
-            s, t, cfg, wait_active, n_active, QC)
-    else:
-        init = (s, jnp.int32(0), jnp.zeros((), bool), jnp.int32(0),
-                Q.JobRec.invalid(), jnp.zeros((), bool),
-                jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0))
-        t_in = s.t
-        s, _, _, n_taken, fail_job, any_fail, buf, cnt = jax.lax.while_loop(
-            dcond, dstep, init)
-        # keep the replicated clock out of the batched carry (_delay_local)
-        s = s.replace(t=t_in)
-    # the drain consumes a strict prefix of the ready queue; its placements
-    # flush into the set before the wait-head attempt reads occupancy
-    s = s.replace(run=R.start_many(s.run, buf, cnt),
-                  ready=Q.pop_front_n(s.ready, n_taken),
-                  wait=Q.push_back(s.wait, fail_job, any_fail),
-                  drops=s.drops.replace(
-                      queue=s.drops.queue + Q.push_back_dropped(s.wait, any_fail)))
-
-    # ---- wait-head attempt (the branch at scheduler.go:219-252) ----
-    process_w = s.wait.count > 0
-    wjob = Q.head(s.wait)
-    s, wsuccess = _attempt(s, wjob, t, process_w, st.SRC_WAIT, cfg.record_trace)
-    s = s.replace(wait=Q.pop_front(s.wait, wsuccess))
-    borrow_want = jnp.logical_and(process_w, jnp.logical_not(wsuccess))
-    if not cfg.borrowing:
-        borrow_want = jnp.zeros((), bool)
-
-    # ---- lent best-effort (scheduler.go:277-291): reached only in a tick
-    # where wait was empty and ready drained clean ----
-    lent_ok = jnp.logical_and(
-        jnp.logical_and(jnp.logical_not(wait_active), jnp.logical_not(any_fail)),
-        jnp.logical_and(s.ready.count == 0, s.lent.count > 0))
-    ljob = Q.head(s.lent)
-    s, lsuccess = _attempt(s, ljob, t, lent_ok, st.SRC_LENT, cfg.record_trace)
-    s = s.replace(lent=Q.pop_front(s.lent, lsuccess))
-    return s, borrow_want, wjob
-
-
 def _borrow_match(state: SimState, want, jobs: Q.JobRec, cfg: SimConfig, ex) -> SimState:
     """Global borrow phase: BorrowResources' broadcast + first-win
     (server.go:160-248) determinized to lowest-lender-cluster-index.
@@ -1226,12 +590,23 @@ class Engine:
     ``ex`` is the cross-cluster exchange (parallel/exchange.py):
     LocalExchange for a whole cluster axis on one device, MeshExchange when
     the tick runs inside shard_map over a mesh (parallel/sharded_engine.py).
+
+    ``policies`` selects the compiled policy repertoire (a
+    ``policies.PolicySet``): ``None`` builds the singleton set for
+    ``cfg.policy`` — the classic one-policy engine, bit-identical to the
+    pre-zoo dispatch (tests/test_policies.py). A multi-member set compiles
+    every member into one program; the run entry points then take a
+    ``PolicyParams`` pytree whose traced ``idx`` picks the member — the
+    axis the tournament driver vmaps over (tools/tournament.py).
     """
 
-    def __init__(self, cfg: SimConfig, ex=None):
+    def __init__(self, cfg: SimConfig, ex=None, policies=None):
         from multi_cluster_simulator_tpu.parallel.exchange import LocalExchange
         self.cfg = cfg
         self.ex = ex if ex is not None else LocalExchange()
+        self.pset = policies if policies is not None else \
+            PolicySet.from_config(cfg)
+        self._default_params = self.pset.params_for(cfg)
         if cfg.n_res not in (2, 3):
             raise ValueError(f"n_res must be 2 or 3, got {cfg.n_res}")
         for field in ("ffd_sweep", "fifo_drain", "delay_sweep"):
@@ -1254,6 +629,18 @@ class Engine:
         else:
             self._trade_round = None
 
+    def policy_provenance(self, params=None) -> dict:
+        """(registered policy name(s), param digest) for detail dicts — the
+        provenance key every bench/probe row records so results stay
+        joinable across BENCH_*.json rounds. With the default params this
+        names the singleton policy; a multi-member engine lists the set."""
+        if len(self.pset.names) == 1:
+            return self.pset.provenance(self.cfg)
+        from multi_cluster_simulator_tpu.policies.base import params_digest
+        p = params if params is not None else self._default_params
+        return {"name": "|".join(self.pset.names),
+                "params_digest": params_digest(p)}
+
     # -- single tick (pure; vmap/global composition) --
     def tick(self, state: SimState, arrivals: Arrivals) -> SimState:
         return self._tick(state, pack_arrivals(arrivals), emit_io=False)[0]
@@ -1263,14 +650,18 @@ class Engine:
         return self._tick(state, pack_arrivals(arrivals), emit_io=True)
 
     def _tick(self, state: SimState, packed_arrivals, emit_io: bool,
-              tick_indexed: bool = False):
+              tick_indexed: bool = False, params=None):
         """The tick body. ``emit_io=False`` (the batch/scan path) skips the
         TickIO packing work when borrowing doesn't need it — the return-slot
         argsort is per-tick cost the headline config shouldn't pay.
         ``tick_indexed``: ``packed_arrivals`` is this tick's
         (rows [C, K, NF], counts [C]) TickArrivals slice instead of the
-        whole stream."""
+        whole stream. ``params``: the PolicyParams pytree selecting and
+        parameterizing the scheduling pass (None = this engine's
+        config-derived defaults, baked as constants)."""
         cfg = self.cfg
+        if params is None:
+            params = self._default_params
         t = state.t + cfg.tick_ms
 
         # compact node storage: widen ONCE at tick entry so every phase
@@ -1308,39 +699,38 @@ class Engine:
             state = jax.vmap(_expire_vnodes_local, in_axes=(_STATE_AXES, None),
                              out_axes=_STATE_AXES)(state, t)
 
-        # 3. arrivals
+        # 3. arrivals — the ingest target is the active policy's (Level0
+        # for the queue-sweep families, ReadyQueue for FIFO). Static when
+        # every compiled set member agrees (the singleton/classic case —
+        # identical to the old cfg.policy branch); a mixed set switches on
+        # the traced index, each branch bitwise the seed path.
         arr_rows, arr_n = packed_arrivals
-        to_delay = cfg.policy in (PolicyKind.DELAY, PolicyKind.FFD)
         ingest = _ingest_packed_local if tick_indexed else _ingest_local
-        state = jax.vmap(functools.partial(ingest, cfg=cfg, to_delay=to_delay),
-                         in_axes=(_STATE_AXES, 0, 0, None),
-                         out_axes=_STATE_AXES)(state, arr_rows, arr_n, t)
 
-        # 4. scheduling pass
-        C = state.arr_ptr.shape[0]
-        want = jnp.zeros((C,), bool)
-        bjob_vec = jnp.zeros((C, Q.NF), jnp.int32)
-        if cfg.policy == PolicyKind.DELAY:
-            delay = (_delay_wave_local
-                     if not cfg.parity and cfg.delay_sweep == "wave"
-                     else _delay_local)
-            state = jax.vmap(functools.partial(delay, cfg=cfg),
-                             in_axes=(_STATE_AXES, None), out_axes=_STATE_AXES)(state, t)
-        elif cfg.policy == PolicyKind.FFD:
-            ffd = (_ffd_wave_local
-                   if not cfg.parity and cfg.ffd_sweep == "wave"
-                   else _ffd_local)
-            state = jax.vmap(functools.partial(ffd, cfg=cfg),
-                             in_axes=(_STATE_AXES, None), out_axes=_STATE_AXES)(state, t)
-        else:  # FIFO
-            state, want, bjobs = jax.vmap(
-                functools.partial(_fifo_local, cfg=cfg),
-                in_axes=(_STATE_AXES, None),
-                out_axes=(_STATE_AXES, 0, 0))(state, t)
-            bjob_vec = bjobs.vec
-            # 5. borrow matching
-            if cfg.borrowing:
-                state = _borrow_match(state, want, bjobs, cfg, self.ex)
+        def run_ingest(s_, to_delay):
+            return jax.vmap(
+                functools.partial(ingest, cfg=cfg, to_delay=to_delay),
+                in_axes=(_STATE_AXES, 0, 0, None),
+                out_axes=_STATE_AXES)(s_, arr_rows, arr_n, t)
+
+        to_delay = self.pset.ingest_to_delay()
+        if to_delay is not None:
+            state = run_ingest(state, to_delay)
+        else:
+            flag = self.pset.to_delay_table()[params.idx]
+            state = jax.lax.cond(flag,
+                                 lambda s_: run_ingest(s_, True),
+                                 lambda s_: run_ingest(s_, False), state)
+
+        # 4. scheduling pass: the policy zoo's dispatch (policies/base.py) —
+        # the member params.idx selects runs its batched kernel; non-FIFO
+        # members emit an all-False borrow_want
+        state, want, bjob_vec = self.pset.dispatch(state, t, params, cfg)
+        # 5. borrow matching (FIFO-family cells only: want is identically
+        # False elsewhere, making the match a bitwise no-op for those cells)
+        if cfg.borrowing and self.pset.has_fifo:
+            state = _borrow_match(state, want, Q.JobRec(vec=bjob_vec), cfg,
+                                  self.ex)
 
         # 6. trader state snapshot (before any trade in the same tick — the
         # stream lands just ahead of the monitor wakeup, MARKET.md §clock)
@@ -1373,7 +763,8 @@ class Engine:
         return state.replace(t=t), io
 
     # -- scan driver --
-    def run(self, state: SimState, arrivals: Arrivals, n_ticks: int):
+    def run(self, state: SimState, arrivals: Arrivals, n_ticks: int,
+            params=None):
         """Advance ``n_ticks``. Returns the final state — or, when
         ``cfg.record_metrics`` is set, ``(state, MetricSample)`` with [T] /
         [T, C] stacked per-tick series (the batch-engine form of RunMetrics'
@@ -1384,7 +775,12 @@ class Engine:
         ``arrivals`` may be an ``Arrivals`` stream or a pre-bucketed
         ``TickArrivals`` (pack_arrivals_by_tick) — the latter feeds each
         tick its slice as a scan input, skipping the per-tick due-window
-        scan over the whole stream."""
+        scan over the whole stream.
+
+        ``params`` (PolicyParams) selects/parameterizes the policy per call
+        — traced data, so a tournament can vmap this function over a
+        (policy, seed) axis with one compile (tools/tournament.py); None
+        bakes this engine's config-derived defaults."""
         record = self.cfg.record_metrics
         if isinstance(arrivals, st.TickArrivals):
             if arrivals.rows.shape[0] < n_ticks:
@@ -1393,7 +789,8 @@ class Engine:
                     f"run asked for {n_ticks}")
 
             def body_ta(s, x):
-                s2 = self._tick(s, x, emit_io=False, tick_indexed=True)[0]
+                s2 = self._tick(s, x, emit_io=False, tick_indexed=True,
+                                params=params)[0]
                 return s2, (st.metric_sample(s2) if record else None)
 
             xs = (arrivals.rows[:n_ticks], arrivals.counts[:n_ticks])
@@ -1403,7 +800,7 @@ class Engine:
         packed = pack_arrivals(arrivals)  # once, outside the tick scan
 
         def body(s, _):
-            s2 = self._tick(s, packed, emit_io=False)[0]
+            s2 = self._tick(s, packed, emit_io=False, params=params)[0]
             return s2, (st.metric_sample(s2) if record else None)
 
         state, series = jax.lax.scan(body, state, None, length=n_ticks)
@@ -1424,7 +821,7 @@ class Engine:
 
     # -- event-compressed driver --
     def run_compressed(self, state: SimState, arrivals: st.TickArrivals,
-                       n_ticks: int):
+                       n_ticks: int, params=None):
         """``run`` with event-compressed virtual time: a ``while_loop`` that
         executes a real 7-phase tick only when something can happen, and
         otherwise leaps the clock to the next event in one step — the
@@ -1448,6 +845,8 @@ class Engine:
         tick index, skipped ticks replicate the fixed point with the
         closed-form wait accrual folded into ``avg_wait_ms``."""
         cfg = self.cfg
+        if params is None:
+            params = self._default_params
         if not isinstance(arrivals, st.TickArrivals):
             raise ValueError("time compression requires pre-bucketed "
                              "TickArrivals (pack_arrivals_by_tick / "
@@ -1495,10 +894,11 @@ class Engine:
             cnt_i = jax.lax.dynamic_index_in_dim(counts, i, 0, keepdims=False)
             sig0 = _quiescence_sig(s)
             s2 = self._tick(s, (rows_i, cnt_i), emit_io=False,
-                            tick_indexed=True)[0]
+                            tick_indexed=True, params=params)[0]
             quiet = self.ex.alland(jnp.all(_quiescence_sig(s2) == sig0))
             # leap target: the clock of the next tick that must execute
-            ev = jnp.minimum(_next_event_t(s2, s2.t, cfg), inf_t)
+            ev = jnp.minimum(
+                _next_event_t(s2, s2.t, cfg, self.pset, params), inf_t)
             ev_clock = ((ev + tick - 1) // tick) * tick  # ceil to tick grid
             na = next_arr[jnp.minimum(i + 1, jnp.int32(n_ticks))]
             arr_clock = t0 + (na + 1) * tick
@@ -1513,7 +913,8 @@ class Engine:
             # the queue) plus two full queue rewrites for an identity
             def leap(s):
                 return jax.vmap(
-                    functools.partial(_leap_local, cfg=cfg),
+                    functools.partial(_leap_local, cfg=cfg, pset=self.pset,
+                                      params=params),
                     in_axes=(_STATE_AXES, None, None),
                     out_axes=(_STATE_AXES, 0))(s, new_t, jnp.bool_(True))
 

@@ -32,6 +32,11 @@ class NodeSpec:
     memory: int
     gpus: int = 0  # 3-dim extension; 0 in every reference asset
     type: str = "physical"
+    # Device type for the heterogeneity-aware policies (ops/fields.py
+    # N_DEVICE_TYPES; Gavel, arxiv 2008.09213). -1 = derive: accelerator
+    # (1) when the node has gpu capacity, standard (0) otherwise. The
+    # reference has no analogue — parity policies never read it.
+    device_type: int = -1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,6 +105,7 @@ def _node_from_json(d: dict) -> NodeSpec:
         memory=int(g("Memory", "memory")),
         gpus=int(g("Gpus", "gpus", default=0)),
         type=str(g("Type", "type", default="physical")),
+        device_type=int(g("DeviceType", "device_type", default=-1)),
     )
 
 
@@ -115,14 +121,30 @@ def load_cluster_json(path: str) -> ClusterSpec:
 
 
 def uniform_cluster(cluster_id: int, n_nodes: int, cores: int = 32,
-                    memory: int = 24_000, gpus: int = 0) -> ClusterSpec:
+                    memory: int = 24_000, gpus: int = 0,
+                    device_type: int = -1) -> ClusterSpec:
     """Synthesize a cluster of identical nodes (the shape of both reference
     assets: 5 or 10 nodes x 32 cores x 24000 MB)."""
     return ClusterSpec(
         id=cluster_id,
-        nodes=tuple(NodeSpec(id=i + 1, cores=cores, memory=memory, gpus=gpus)
+        nodes=tuple(NodeSpec(id=i + 1, cores=cores, memory=memory, gpus=gpus,
+                             device_type=device_type)
                     for i in range(n_nodes)),
     )
+
+
+def node_types_array(specs: Sequence[ClusterSpec], max_nodes: int) -> np.ndarray:
+    """Stack per-node device types into a padded [C, max_nodes] int32 tensor
+    (the node half of the heterogeneity schema — ops/fields.py). A spec's
+    explicit ``device_type`` wins; -1 derives accelerator (1) from gpu
+    capacity; padded slots are standard (0, and never feasible anyway)."""
+    C = len(specs)
+    types = np.zeros((C, max_nodes), dtype=np.int32)
+    for c, spec in enumerate(specs):
+        for i, n in enumerate(spec.nodes[:max_nodes]):
+            types[c, i] = n.device_type if n.device_type >= 0 else (
+                1 if n.gpus > 0 else 0)
+    return types
 
 
 def capacities_array(specs: Sequence[ClusterSpec], max_nodes: int) -> np.ndarray:

@@ -19,7 +19,9 @@ import numpy as np
 from flax import struct
 
 from multi_cluster_simulator_tpu.config import SimConfig
-from multi_cluster_simulator_tpu.core.spec import CORES, MEM, RES, ClusterSpec, capacities_array
+from multi_cluster_simulator_tpu.core.spec import (
+    CORES, MEM, RES, ClusterSpec, capacities_array, node_types_array,
+)
 from multi_cluster_simulator_tpu.ops import queues as Q
 from multi_cluster_simulator_tpu.ops import runset as R
 
@@ -139,6 +141,9 @@ class SimState:
     node_free: jax.Array  # [C, N, RES] i32
     node_active: jax.Array  # [C, N] bool
     node_expire: jax.Array  # [C, N] i32 — virtual-node expiry (NEVER default)
+    node_type: jax.Array  # [C, N] i32 — device type per slot (static world
+    #                       fact from the specs; the heterogeneity-aware
+    #                       policies score placements by it — policies/)
     # queues (reference scheduler.go:19-30)
     l0: Q.JobQueue  # [C, ...] DELAY Level0
     l1: Q.JobQueue  # DELAY Level1
@@ -157,6 +162,17 @@ class SimState:
     drops: Drops
     trader: TraderState
     trace: Trace
+
+
+# vmap prefix for the per-cluster tick phases: map every per-cluster field
+# over axis 0, broadcast the (replicated) clock. Shared by the engine's
+# phase vmaps and the policy kernels' batched wrappers (policies/base.py).
+STATE_AXES = SimState(
+    t=None, node_cap=0, node_free=0, node_active=0, node_expire=0,
+    node_type=0, l0=0, l1=0, ready=0, wait=0, lent=0, borrowed=0, run=0,
+    arr_ptr=0, wait_total=0, wait_jobs=0, jobs_in_queue=0, placed_total=0,
+    drops=0, trader=0, trace=0,
+)
 
 
 def avg_wait_ms(s: SimState) -> jax.Array:
@@ -252,6 +268,10 @@ def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec],
     cap = np.zeros((C, N, cfg.n_res), dtype=node_dt)
     cap[:, : cfg.max_nodes] = phys
     active = (cap.sum(-1) > 0)
+    # device types: physical slots from the specs, virtual slots standard
+    # (a borrowed virtual node carries generic capacity)
+    ntype = np.zeros((C, N), dtype=np.int32)
+    ntype[:, : cfg.max_nodes] = node_types_array(specs, cfg.max_nodes)
 
     def batch(tree):
         return jax.tree.map(lambda a: jnp.broadcast_to(a, (C,) + a.shape),
@@ -274,6 +294,7 @@ def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec],
         node_free=jnp.asarray(cap.copy()),
         node_active=jnp.asarray(active),
         node_expire=never,
+        node_type=jnp.asarray(ntype),
         l0=batched_queue(),
         l1=batched_queue(),
         ready=batched_queue(),

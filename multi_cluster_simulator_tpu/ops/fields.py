@@ -30,12 +30,41 @@ import numpy as np
 # --------------------------------------------------------------------------
 
 # (cores, mem, gpu) are contiguous and ordered like the node-tensor resource
-# axis (core/spec.py RES) so JobRec.res is one slice
+# axis (core/spec.py RES) so JobRec.res is one slice. ``jclass`` (the ninth
+# field, PR 6) is the job's canonical demand-shape class — the row index
+# into a heterogeneity-aware policy's per-(class, device-type) throughput
+# matrix (policies/kernels.py gavel; Gavel, arxiv 2008.09213). It is derived
+# once at stream entry (``job_class``) and rides the row thereafter.
 QUEUE_FIELDS = ("id", "cores", "mem", "gpu", "dur", "enq_t", "owner",
-                "rec_wait")
+                "rec_wait", "jclass")
 QUEUE_INDEX = {name: i for i, name in enumerate(QUEUE_FIELDS)}
 # invalid-slot sentinel per field: id=-1, owner=OWN(-1), zeros elsewhere
-QUEUE_INVALID = (-1, 0, 0, 0, 0, 0, -1, 0)
+QUEUE_INVALID = (-1, 0, 0, 0, 0, 0, -1, 0, 0)
+
+# --------------------------------------------------------------------------
+# heterogeneity schema: job demand-shape classes x node device types
+# (the one-site extension the Gavel-style policy keys on)
+# --------------------------------------------------------------------------
+
+# Classes bucket the demand SHAPE, not the amount: the gpu/cpu split and a
+# big/small core split — the axes along which per-device-type throughput
+# plausibly differs. Device types label node slots (core/spec.py
+# node_types_array; SimState.node_type): 0 = standard, 1 = accelerator
+# (derived from gpu capacity unless a NodeSpec pins it), 2-3 reserved for
+# explicit spec overrides. Both counts are STATIC schema constants — they
+# size the policy-parameter throughput matrix (policies.PolicyParams
+# .gavel_tput), which is a pytree leaf and must have one shape across a
+# vmapped policy sweep.
+N_JOB_CLASSES = 4
+N_DEVICE_TYPES = 4
+
+
+def job_class(cores, gpu):
+    """Canonical demand-shape class in [0, N_JOB_CLASSES): bit 1 = needs
+    gpu, bit 0 = core-heavy. Pure elementwise integer arithmetic — works on
+    host numpy (the arrival pack paths) and on tracers alike; callers cast
+    to their storage dtype."""
+    return (gpu > 0) * 2 + (cores > 8) * 1
 
 # --------------------------------------------------------------------------
 # running-set row schema (ops/runset.py)
@@ -58,7 +87,8 @@ RUN_INVALID = (NEVER_I, 0, 0, 0, 0, -1, -1, 0, 0)
 # ids (narrowed only when a stream audit proves the range — the planner
 # keeps i32 otherwise, and the checked store counts any host-injected id
 # beyond the audited bound instead of wrapping).
-NARROWABLE = frozenset({"id", "cores", "mem", "gpu", "owner", "node"})
+NARROWABLE = frozenset({"id", "cores", "mem", "gpu", "owner", "node",
+                        "jclass"})
 
 WIDE_DTYPE = np.dtype(np.int32)
 

@@ -53,6 +53,19 @@ def first_fit(free: jax.Array, active: jax.Array, job: JobRec, strict: bool = Fa
     return jnp.where(jnp.any(mask), idx, NO_NODE)
 
 
+def best_scored_fit(free: jax.Array, active: jax.Array, job: JobRec,
+                    scores: jax.Array) -> jax.Array:
+    """Highest-scoring feasible node (ties -> lowest index, matching the
+    reference's first-fit orientation), or NO_NODE. ``scores`` is a finite
+    [N] f32 preference vector — the scored-policy kernels (policies/
+    kernels.py: gavel throughput, tesserae packing alignment) supply it;
+    with a constant vector this degenerates to ``first_fit``."""
+    mask = feasible(free, active, job.cores, job.mem, job.gpu)
+    sc = jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
+    idx = jnp.argmax(sc).astype(jnp.int32)  # first max: lowest-index tie win
+    return jnp.where(jnp.any(mask), idx, NO_NODE)
+
+
 def can_lend(free: jax.Array, active: jax.Array, job: JobRec) -> jax.Array:
     """Lend() feasibility: any node with strictly more free than needed."""
     return jnp.any(feasible(free, active, job.cores, job.mem, job.gpu,

@@ -47,8 +47,10 @@ OWN = jnp.int32(-1)  # owner value for "my own job" (Ownership == "")
 
 # packed row layout, derived from the canonical schema (ops/fields.py)
 NF = len(F.QUEUE_FIELDS)
-FID, FCORES, FMEM, FGPU, FDUR, FENQ, FOWNER, FREC = (
-    F.QUEUE_INDEX[n] for n in F.QUEUE_FIELDS)
+(FID, FCORES, FMEM, FGPU, FDUR, FENQ, FOWNER, FREC, FJCLASS) = (
+    F.QUEUE_INDEX[n]
+    for n in ("id", "cores", "mem", "gpu", "dur", "enq_t", "owner",
+              "rec_wait", "jclass"))
 _FIDX = dict(F.QUEUE_INDEX)
 
 _INVALID_ROW = jnp.array(F.QUEUE_INVALID, jnp.int32)
@@ -94,14 +96,20 @@ class JobRec:
         return self.vec[..., FREC]
 
     @property
+    def jclass(self):
+        return self.vec[..., FJCLASS]
+
+    @property
     def res(self):
         """[..., RES] (cores, mem, gpu) — matches the node free/cap layout."""
         return self.vec[..., FCORES:FGPU + 1]
 
     @staticmethod
     def make(id=-1, cores=0, mem=0, gpu=0, dur=0, enq_t=0, owner=OWN,
-             rec_wait=0) -> "JobRec":
-        parts = [id, cores, mem, gpu, dur, enq_t, owner, rec_wait]
+             rec_wait=0, jclass=None) -> "JobRec":
+        if jclass is None:
+            jclass = F.job_class(jnp.asarray(cores), jnp.asarray(gpu))
+        parts = [id, cores, mem, gpu, dur, enq_t, owner, rec_wait, jclass]
         return JobRec(vec=jnp.stack([jnp.asarray(p, jnp.int32) for p in parts], axis=-1))
 
     @staticmethod
@@ -157,6 +165,10 @@ class JobQueue:
     def rec_wait(self):
         return self.data[..., FREC]
 
+    @property
+    def jclass(self):
+        return self.data[..., FJCLASS]
+
     def slot_valid(self) -> jax.Array:
         """[Q] bool — which slots hold live jobs."""
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.count
@@ -184,6 +196,7 @@ class SoAJobQueue:
     f_enq_t: jax.Array
     f_owner: jax.Array
     f_rec_wait: jax.Array
+    f_jclass: jax.Array
     count: jax.Array  # [] int32
     ovf: jax.Array  # [] int32 — checked-narrow overflow events
 
@@ -223,6 +236,10 @@ class SoAJobQueue:
     @property
     def rec_wait(self):
         return F.widen(self.f_rec_wait)
+
+    @property
+    def jclass(self):
+        return F.widen(self.f_jclass)
 
     def slot_valid(self) -> jax.Array:
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.count
@@ -283,10 +300,13 @@ def soa_to_wide(q: SoAJobQueue) -> JobQueue:
     return JobQueue(data=data, count=jnp.asarray(q.count, jnp.int32))
 
 
-def from_fields(id, cores, mem, gpu, dur, enq_t, owner, rec_wait, count) -> JobQueue:
+def from_fields(id, cores, mem, gpu, dur, enq_t, owner, rec_wait, count,
+                jclass=None) -> JobQueue:
     """Build a wide queue from per-field [Q] arrays (one stack op)."""
-    data = jnp.stack([id, cores, mem, gpu, dur, enq_t, owner, rec_wait],
-                     axis=-1).astype(jnp.int32)
+    if jclass is None:
+        jclass = F.job_class(jnp.asarray(cores), jnp.asarray(gpu))
+    data = jnp.stack([id, cores, mem, gpu, dur, enq_t, owner, rec_wait,
+                      jclass], axis=-1).astype(jnp.int32)
     return JobQueue(data=data, count=jnp.asarray(count, jnp.int32))
 
 

@@ -47,8 +47,8 @@ def _state_specs(axis: str):
     shard, rep = P(axis), P()
     return SimState(
         t=rep, node_cap=shard, node_free=shard, node_active=shard,
-        node_expire=shard, l0=shard, l1=shard, ready=shard, wait=shard,
-        lent=shard, borrowed=shard, run=shard, arr_ptr=shard,
+        node_expire=shard, node_type=shard, l0=shard, l1=shard, ready=shard,
+        wait=shard, lent=shard, borrowed=shard, run=shard, arr_ptr=shard,
         wait_total=shard, wait_jobs=shard, jobs_in_queue=shard,
         placed_total=shard, drops=shard, trader=shard, trace=shard)
 
@@ -71,13 +71,14 @@ class ShardedEngine:
     ``shard_inputs`` to place host-built state/arrivals onto the mesh.
     """
 
-    def __init__(self, cfg: SimConfig, mesh: Mesh, axis: str = "clusters"):
+    def __init__(self, cfg: SimConfig, mesh: Mesh, axis: str = "clusters",
+                 policies=None):
         if axis not in mesh.axis_names:
             raise ValueError(f"mesh has no axis {axis!r}: {mesh.axis_names}")
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
-        self.engine = Engine(cfg, ex=MeshExchange(axis))
+        self.engine = Engine(cfg, ex=MeshExchange(axis), policies=policies)
 
     def shard_inputs(self, state: SimState, arrivals: Arrivals, place=None):
         """Place state/arrivals onto the mesh. ``place(leaf, sharding)``
@@ -103,7 +104,8 @@ class ShardedEngine:
         return _device_put_tree(arrivals, specs, self.mesh, place)
 
     def run_fn(self, n_ticks: int, tick_indexed: bool = False,
-               donate: bool = False, time_compress: bool = False):
+               donate: bool = False, time_compress: bool = False,
+               with_params: bool = False):
         """A jitted (state, arrivals) -> state advancing n_ticks under
         shard_map (``(state, MetricSample)`` when cfg.record_metrics: the
         [T, C] series stays cluster-sharded on its second axis).
@@ -116,16 +118,20 @@ class ShardedEngine:
         event-compressed driver instead of the dense scan: the per-shard
         quiescence votes and leap targets ride the mesh exchange
         (``alland``/``allmin``) so every shard executes the same ticks,
-        and a replicated ``LeapStats`` is appended to the outputs."""
+        and a replicated ``LeapStats`` is appended to the outputs.
+        ``with_params=True`` adds a third argument — a replicated
+        ``PolicyParams`` pytree selecting the policy per call (the
+        policy-as-data axis; every shard must receive the same cell)."""
         eng = self.engine
         if time_compress and not tick_indexed:
             raise ValueError("time_compress requires tick_indexed "
                              "(pre-bucketed TickArrivals)")
 
-        def body(state, arrivals):
+        def body(state, arrivals, params=None):
             if time_compress:
-                return eng.run_compressed(state, arrivals, n_ticks)
-            return eng.run(state, arrivals, n_ticks)
+                return eng.run_compressed(state, arrivals, n_ticks,
+                                          params=params)
+            return eng.run(state, arrivals, n_ticks, params=params)
 
         out_specs = _state_specs(self.axis)
         if self.cfg.record_metrics:
@@ -142,9 +148,12 @@ class ShardedEngine:
                 out_specs = (out_specs, stats_spec)
         arr_specs = (_tick_arr_specs(self.axis) if tick_indexed
                      else _arr_specs(self.axis))
+        in_specs = (_state_specs(self.axis), arr_specs)
+        if with_params:
+            in_specs = in_specs + (P(),)  # params replicated on every shard
         mapped = _shard_map(
             body, mesh=self.mesh,
-            in_specs=(_state_specs(self.axis), arr_specs),
+            in_specs=in_specs,
             out_specs=out_specs,
             **_SHARD_MAP_KW)
         return jax.jit(mapped, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,18 @@
+"""The policy zoo: scheduling policies as data (ARCHITECTURE.md §policy zoo).
+
+``PolicySet`` + ``PolicyParams`` turn the scheduler repertoire into one
+compiled program whose active policy is a traced index and whose knobs are
+pytree leaves — the interface the tournament driver (tools/tournament.py),
+the RL-environment mode, and the serving tier all plug into. Kernels live
+in ``policies.kernels``; registration in ``policies.base``.
+"""
+
+from multi_cluster_simulator_tpu.policies.base import (
+    KINDS, REGISTRY, PolicyParams, PolicySet, PolicySpec, default_params,
+    params_digest, register, variant,
+)
+
+__all__ = [
+    "KINDS", "REGISTRY", "PolicyParams", "PolicySet", "PolicySpec",
+    "default_params", "params_digest", "register", "variant",
+]

@@ -1,0 +1,324 @@
+"""Policy-as-data: the parameter pytree, the registry, and the dispatcher.
+
+The seed engine chose its scheduling pass with Python-level ``cfg.policy``
+branches — every policy variant was a distinct compiled program, so an A/B
+across the repertoire paid one trace + one compile + one H2D pipeline per
+variant (tools/market_ab.py was the template). Here a policy is DATA:
+
+- ``PolicyParams`` — one pytree of parameter leaves shared by every kernel
+  (a traced selector index plus each family's knobs). Leaves, not config:
+  a vmapped tournament batches them over the (policy, seed) axis and a
+  single compiled program evaluates the whole repertoire
+  (tools/tournament.py).
+- ``PolicySpec`` / ``register`` — the registered table: name -> kernel
+  KIND (the compute body in policies/kernels.py), ingest target, and
+  default parameter overrides. Registration is additive; the eight
+  built-ins below cover the reference repertoire plus the Gavel- and
+  Tesserae-style zoo members.
+- ``PolicySet`` — the STATIC tuple of registered names compiled into one
+  program. Which member runs is the TRACED ``params.idx``: members of one
+  kernel kind share code (their differences are parameter leaves — free to
+  sweep), distinct kinds become branches of one ``lax.switch``. A
+  singleton set (every pre-tournament entry point: ``Engine(cfg)``)
+  short-circuits to a direct call — the exact seed code path, pinned
+  bit-identical by tests/test_policies.py.
+
+The RL-environment (ROADMAP item 2) and serving (item 4) PRs plug in here:
+a learned scheduler is one more registered kind whose params happen to be
+network outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.core.state import STATE_AXES, SimState
+from multi_cluster_simulator_tpu.ops import queues as Q
+from multi_cluster_simulator_tpu.policies import kernels as K
+
+
+@struct.dataclass
+class PolicyParams:
+    """Per-policy parameter leaves — the data in policy-as-data.
+
+    One flat schema shared by every kernel family (a kernel reads the
+    leaves it understands and ignores the rest), so a batched sweep can
+    stack heterogeneous policies along one axis. All leaves are traced;
+    none may steer Python control flow (simlint: policy-kernel)."""
+
+    idx: jax.Array  # [] i32 — which PolicySet member this cell runs
+    max_wait_ms: jax.Array  # [] i32 — DELAY Level0->Level1 promotion
+    ffd_mem_first: jax.Array  # [] i32 — FFD sort tie-break (0: cores-first)
+    gavel_tput: jax.Array  # [N_JOB_CLASSES, N_DEVICE_TYPES] f32 throughput
+    tess_w: jax.Array  # [3] f32 — tesserae resource weights (cores/mem/gpu)
+
+
+# Default Gavel throughput matrix [job class, device type]: gpu-class work
+# (classes 2-3) runs ~3x faster on accelerator nodes (type 1) and pays a
+# penalty on standard ones; cpu-class work is indifferent. Types 2-3 are
+# spec-defined and default to standard throughput.
+_DEFAULT_GAVEL_TPUT = (
+    (1.0, 1.0, 1.0, 1.0),
+    (1.0, 1.0, 1.0, 1.0),
+    (0.5, 3.0, 1.0, 1.0),
+    (0.5, 3.0, 1.0, 1.0),
+)
+# Tesserae alignment weights: mem is O(1000x) cores in magnitude — weigh it
+# down so neither axis dominates the demand·free dot product by units alone.
+_DEFAULT_TESS_W = (1.0, 1e-3, 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicySpec:
+    """One registered policy: a kernel KIND plus parameter overrides.
+
+    ``kind`` names the compute body (policies/kernels.py); ``to_delay``
+    picks the arrival ingest target (Level0 for the queue-sweep families,
+    ReadyQueue for FIFO — the engine's phase-3 split). ``overrides`` is a
+    hashable tuple of (PolicyParams leaf name, value) pairs applied over
+    the config-derived defaults — what makes two same-kind variants
+    different policies."""
+
+    name: str
+    kind: str  # "fifo" | "delay" | "ffd" | "gavel" | "tesserae"
+    to_delay: bool
+    overrides: tuple = ()
+
+
+KINDS = ("fifo", "delay", "ffd", "gavel", "tesserae")
+
+REGISTRY: dict[str, PolicySpec] = {}
+
+
+def register(spec: PolicySpec) -> PolicySpec:
+    """Add a policy to the registered table (idempotent re-registration of
+    an identical spec is allowed; changing an existing name is an error —
+    recorded digests would silently stop being joinable)."""
+    if spec.kind not in KINDS:
+        raise ValueError(f"unknown policy kind {spec.kind!r}; one of {KINDS}")
+    prev = REGISTRY.get(spec.name)
+    if prev is not None and prev != spec:
+        raise ValueError(f"policy {spec.name!r} already registered as {prev}")
+    REGISTRY[spec.name] = spec
+    return spec
+
+
+def variant(name: str, base: str, **overrides) -> PolicySpec:
+    """Register a parameter variant of an existing policy: same kernel
+    kind, different parameter leaves — the free axis of a tournament."""
+    b = REGISTRY[base]
+    ov = dict(b.overrides)
+    ov.update(overrides)
+    return register(PolicySpec(name=name, kind=b.kind, to_delay=b.to_delay,
+                               overrides=tuple(sorted(ov.items()))))
+
+
+# The built-in zoo: the reference repertoire, the heterogeneity/packing
+# members, and enough parameter variants for an 8-wide tournament out of
+# the box. Names are the provenance key recorded in every bench detail.
+register(PolicySpec("fifo", kind="fifo", to_delay=False))
+register(PolicySpec("delay", kind="delay", to_delay=True))
+register(PolicySpec("ffd", kind="ffd", to_delay=True))
+register(PolicySpec("gavel", kind="gavel", to_delay=True))
+register(PolicySpec("tesserae", kind="tesserae", to_delay=True))
+variant("delay-eager", "delay", max_wait_ms=2_000)
+variant("delay-patient", "delay", max_wait_ms=30_000)
+variant("ffd-memfirst", "ffd", ffd_mem_first=1)
+
+
+def default_params(cfg: SimConfig, spec: PolicySpec, idx: int = 0) -> PolicyParams:
+    """The spec's parameter pytree: config-derived defaults + the spec's
+    overrides, as concrete device-committable arrays. With no overrides
+    this reproduces the seed ``cfg.*`` constants exactly (the dispatch
+    bit-equality contract)."""
+    vals = {
+        "max_wait_ms": np.int32(cfg.max_wait_ms),
+        "ffd_mem_first": np.int32(0),
+        "gavel_tput": np.asarray(_DEFAULT_GAVEL_TPUT, np.float32),
+        "tess_w": np.asarray(_DEFAULT_TESS_W, np.float32),
+    }
+    for name, val in spec.overrides:
+        if name not in vals:
+            raise ValueError(f"{spec.name}: unknown param override {name!r}")
+        vals[name] = np.asarray(val, vals[name].dtype)
+    return PolicyParams(idx=jnp.int32(idx),
+                        max_wait_ms=jnp.asarray(vals["max_wait_ms"]),
+                        ffd_mem_first=jnp.asarray(vals["ffd_mem_first"]),
+                        gavel_tput=jnp.asarray(vals["gavel_tput"]),
+                        tess_w=jnp.asarray(vals["tess_w"]))
+
+
+def params_digest(params: PolicyParams) -> str:
+    """Provenance digest of concrete parameter leaves: bench/tournament
+    rows carry (policy name, digest) so results are joinable across
+    BENCH_*.json rounds even as defaults evolve. Host-side only."""
+    h = hashlib.sha1()
+    for path, leaf in sorted(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            key=lambda kv: str(kv[0])):
+        h.update(str(path).encode())
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()[:12]
+
+
+# --------------------------------------------------------------------------
+# dispatch
+# --------------------------------------------------------------------------
+
+
+def _zero_io(state: SimState):
+    C = state.arr_ptr.shape[0]
+    return jnp.zeros((C,), bool), jnp.zeros((C, Q.NF), jnp.int32)
+
+
+def _run_kind(spec: PolicySpec, state: SimState, t, params, cfg: SimConfig):
+    """One policy's whole scheduling pass, vmapped over the cluster axis.
+    Uniform output shape across kinds — (state, borrow_want, borrow_job
+    rows) — so kinds can be branches of one ``lax.switch``; the non-FIFO
+    families emit an all-False want (the engine's borrow phase is then a
+    bitwise no-op for their cells)."""
+    if spec.kind == "fifo":
+        state, want, bjobs = jax.vmap(
+            functools.partial(K._fifo_local, cfg=cfg, params=params),
+            in_axes=(STATE_AXES, None),
+            out_axes=(STATE_AXES, 0, 0))(state, t)
+        return state, want, bjobs.vec
+    if spec.kind == "delay":
+        fn = (K._delay_wave_local
+              if not cfg.parity and cfg.delay_sweep == "wave"
+              else K._delay_local)
+    elif spec.kind == "ffd":
+        fn = (K._ffd_wave_local
+              if not cfg.parity and cfg.ffd_sweep == "wave"
+              else K._ffd_local)
+    elif spec.kind == "gavel":
+        fn = K._gavel_local
+    else:  # tesserae
+        fn = K._tesserae_local
+    state = jax.vmap(functools.partial(fn, cfg=cfg, params=params),
+                     in_axes=(STATE_AXES, None),
+                     out_axes=STATE_AXES)(state, t)
+    want, bjob = _zero_io(state)
+    return state, want, bjob
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicySet:
+    """The static tuple of registered policy names one compiled program can
+    run; ``params.idx`` (traced) selects the member. Hashable, so it rides
+    Engine closures and jit caches like the config does."""
+
+    names: tuple
+
+    def __post_init__(self):
+        if not self.names:
+            raise ValueError("PolicySet needs at least one policy name")
+        for n in self.names:
+            if n not in REGISTRY:
+                raise ValueError(
+                    f"unregistered policy {n!r}; known: {sorted(REGISTRY)}")
+
+    @classmethod
+    def from_config(cls, cfg: SimConfig) -> "PolicySet":
+        """The singleton set for a classic ``cfg.policy`` run."""
+        return cls((cfg.policy.value.lower(),))
+
+    @property
+    def specs(self) -> tuple:
+        return tuple(REGISTRY[n] for n in self.names)
+
+    @property
+    def kinds(self) -> tuple:
+        return tuple(s.kind for s in self.specs)
+
+    @property
+    def has_fifo(self) -> bool:
+        return "fifo" in self.kinds
+
+    def index_of(self, name: str) -> int:
+        return self.names.index(name)
+
+    def params_for(self, cfg: SimConfig, name=None) -> PolicyParams:
+        """Concrete PolicyParams for one member (the first by default),
+        idx set to its position in this set."""
+        name = self.names[0] if name is None else name
+        i = self.index_of(name)
+        return default_params(cfg, self.specs[i], idx=i)
+
+    def stacked_params(self, cfg: SimConfig) -> PolicyParams:
+        """All members' params stacked on a leading axis — the policy axis
+        a tournament vmaps over."""
+        cells = [self.params_for(cfg, n) for n in self.names]
+        return jax.tree.map(lambda *ls: jnp.stack(ls), *cells)
+
+    def provenance(self, cfg: SimConfig, name=None) -> dict:
+        """(registered name, param digest) for detail dicts."""
+        name = self.names[0] if name is None else name
+        return {"name": name,
+                "params_digest": params_digest(self.params_for(cfg, name))}
+
+    # -- traced dispatch ---------------------------------------------------
+
+    def ingest_to_delay(self):
+        """Arrival ingest target across the set: a static bool when every
+        member agrees, else None (the engine then switches on a traced
+        per-member table)."""
+        targets = {s.to_delay for s in self.specs}
+        return targets.pop() if len(targets) == 1 else None
+
+    def to_delay_table(self) -> jax.Array:
+        return jnp.asarray([s.to_delay for s in self.specs])
+
+    def kind_flag_table(self, kind: str) -> jax.Array:
+        return jnp.asarray([s.kind == kind for s in self.specs])
+
+    def dispatch(self, state: SimState, t, params: PolicyParams,
+                 cfg: SimConfig):
+        """The phase-4 scheduling pass: run the member ``params.idx``
+        selects. Same-kind members share one code path (their differences
+        are parameter leaves); distinct kinds are ``lax.switch`` branches
+        over a static member->branch table. A scalar (per-cell) index
+        executes only the selected branch; only a vmap that batches the
+        index itself pays for all branches."""
+        distinct = []
+        branch_of = []
+        for spec in self.specs:
+            key = (spec.kind, spec.to_delay)
+            if key not in [(s.kind, s.to_delay) for s in distinct]:
+                distinct.append(spec)
+            branch_of.append(
+                [(s.kind, s.to_delay) for s in distinct].index(key))
+        if len(distinct) == 1:
+            return _run_kind(distinct[0], state, t, params, cfg)
+        branches = tuple(functools.partial(_run_kind, spec, cfg=cfg)
+                         for spec in distinct)
+        bidx = jnp.asarray(branch_of, jnp.int32)[params.idx]
+        return jax.lax.switch(bidx, branches, state, t, params)
+
+    def leap_masks(self, s: SimState, cfg: SimConfig, params: PolicyParams):
+        """Per-kind leap-accrual masks (kernels.leap_wait_masks) under the
+        same member->branch dispatch as the scheduling pass; single-cluster
+        view (called inside the engine's per-cluster vmap)."""
+        kinds = []
+        branch_of = []
+        for spec in self.specs:
+            if spec.kind not in kinds:
+                kinds.append(spec.kind)
+            branch_of.append(kinds.index(spec.kind))
+        if len(kinds) == 1:
+            return K.leap_wait_masks(kinds[0], s, cfg, params)
+
+        def mask_fn(kind):
+            return lambda s_, p_: K.leap_wait_masks(kind, s_, cfg, p_)
+
+        bidx = jnp.asarray(branch_of, jnp.int32)[params.idx]
+        return jax.lax.switch(bidx, tuple(mask_fn(k) for k in kinds),
+                              s, params)

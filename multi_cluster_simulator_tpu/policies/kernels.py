@@ -1,0 +1,838 @@
+"""Per-cluster scheduling-pass kernels — the policy zoo's compute bodies.
+
+Every policy is a batched kernel over the SoA state columns: a pure
+function ``(SimState-slice, t, cfg, params) -> SimState-slice`` vmapped
+over the cluster axis by the dispatch layer (policies/base.py). The
+reference policies (FIFO/DELAY — pkg/scheduler/scheduler.go; FFD — the
+TPU-side upgrade) moved here verbatim from core/engine.py when placement
+became policy-as-data (PR 6): their semantics, docstrings, and bit-parity
+obligations are unchanged, and the engine re-exports their names.
+
+``params`` is the policy's parameter pytree (policies.PolicyParams):
+TRACED data, not config — a vmapped tournament batches it over the
+(policy, seed) axis with zero recompiles. Kernels must read policy knobs
+from it (never from ``cfg.policy``-style static branches) and must stay
+tracer-pure and branchless on traced values — simlint's ``policy-kernel``
+rule family enforces both over this package. ``params=None`` falls back to
+the config values (the pre-refactor standalone call shape, kept for the
+phase probes).
+
+New zoo members (no reference analogue, hence no Go-parity constraint):
+
+- ``_gavel_local`` — round-based heterogeneity-aware placement in the
+  spirit of Gavel (arxiv 2008.09213): each tick is an allocation round;
+  jobs pick the feasible node whose device type maximizes the job class's
+  throughput (``params.gavel_tput``, a [N_JOB_CLASSES, N_DEVICE_TYPES]
+  leaf), so gpu-class work lands on accelerator nodes while cpu-class work
+  keeps standard nodes free.
+- ``_tesserae_local`` — packing-aware scoring in the spirit of Tesserae
+  (arxiv 2508.04953) / Tetris: jobs sweep in decreasing-demand order and
+  pick the feasible node with the highest demand·free alignment
+  (``params.tess_w`` weighs the resource axes), steering complementary
+  shapes onto the same node instead of first-fit fragmentation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.core import state as st
+from multi_cluster_simulator_tpu.core.state import SimState, Trace
+from multi_cluster_simulator_tpu.ops import fields as F
+from multi_cluster_simulator_tpu.ops import placement as P
+from multi_cluster_simulator_tpu.ops import queues as Q
+from multi_cluster_simulator_tpu.ops import runset as R
+
+
+def _trace_append(tr: Trace, do, t, job_id, node, src):
+    """Per-cluster capped event append (single-cluster view)."""
+    cap = tr.t.shape[-1]
+    ok = jnp.logical_and(do, tr.n < cap)
+    i = jnp.clip(tr.n, 0, cap - 1)
+
+    def w(a, v):
+        return a.at[i].set(jnp.where(ok, v, a[i]))
+
+    return Trace(t=w(tr.t, t), job=w(tr.job, job_id), node=w(tr.node, node),
+                 src=w(tr.src, jnp.int32(src)), n=tr.n + ok.astype(jnp.int32))
+
+
+def _trace_append_many(tr, take, t, job_ids, nodes, src):
+    """Batch form of ``_trace_append``: append events for positions where
+    ``take``, in position order — bit-identical to appending them one by
+    one. One [K, cap] one-hot contraction instead of K cursor writes."""
+    cap = tr.t.shape[-1]
+    rank = jnp.cumsum(take.astype(jnp.int32)) - 1
+    idx = tr.n + rank
+    ok = jnp.logical_and(take, idx < cap)
+    hot = jnp.logical_and(
+        ok[:, None], idx[:, None] == jnp.arange(cap, dtype=jnp.int32)[None, :]
+    ).astype(jnp.int32)  # [K, cap]
+    untouched = hot.sum(axis=0) == 0  # [cap]
+
+    def w(a, vals):
+        return jnp.where(untouched, a, jnp.einsum("kc,k->c", hot,
+                                                  vals.astype(jnp.int32)))
+
+    src_v = jnp.full(take.shape, jnp.int32(src))
+    t_v = jnp.full(take.shape, jnp.asarray(t, jnp.int32))
+    return tr.replace(t=w(tr.t, t_v), job=w(tr.job, job_ids),
+                      node=w(tr.node, nodes), src=w(tr.src, src_v),
+                      n=tr.n + ok.sum().astype(jnp.int32))
+
+
+def _attempt(s: SimState, job: Q.JobRec, t, do, src, record_trace: bool):
+    """One ScheduleJob(j) attempt (scheduler.go:127-139) on a single cluster:
+    first-fit over nodes; on success occupy resources and start the job.
+
+    A full running set makes the attempt fail (job stays queued) rather than
+    leak resources — a documented divergence (PARITY.md): size
+    ``max_running`` so it never binds.
+
+    One shared body with the sweep loops: a single-row deferred buffer
+    flushed immediately (start_many of one row == start), so placement
+    accounting can never drift between the head attempts and the sweeps."""
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
+    buf = jnp.zeros((1, R.RF), jnp.int32)
+    s, success, buf, cnt = _attempt_deferred(s, job, t, do, src, record_trace,
+                                             buf, jnp.int32(0), n_active)
+    return s.replace(run=R.start_many(s.run, buf, cnt)), success
+
+
+def _attempt_deferred(s: SimState, job: Q.JobRec, t, do, src,
+                      record_trace: bool, buf, cnt, n_active, node=None):
+    """``_attempt`` for placement-sweep loops: identical semantics, but the
+    RunningSet insertion is deferred — the placed row lands in ``buf`` at
+    position ``cnt`` (a [SW, RF] scratch, SW = sweep bound) and the caller
+    flushes the batch with ``R.start_many`` after the loop. The [S]-sized
+    set is then touched once per tick instead of once per sweep step, which
+    dominated the per-tick cost at thousands of clusters. ``n_active`` is
+    the set's occupancy at loop entry; ``n_active + cnt`` reproduces the
+    sequential has-slot check exactly.
+
+    ``node`` overrides the target-selection rule: ``None`` keeps the
+    reference's first-fit scan; the scored policies (gavel/tesserae) pass
+    their own pick (``P.best_scored_fit``) — everything else (occupancy,
+    accounting, trace, drops) is shared so the zoo can never drift on the
+    bookkeeping."""
+    if node is None:
+        node = P.first_fit(s.node_free, s.node_active, job)
+    has_slot = (n_active + cnt) < s.run.capacity
+    success = jnp.logical_and(jnp.logical_and(do, has_slot), node >= 0)
+    free = P.occupy(s.node_free, node, job, success)
+    row = R.row_from_job(job, node, t)
+    hot = jnp.logical_and(jnp.arange(buf.shape[0], dtype=jnp.int32) == cnt,
+                          success)
+    buf = jnp.where(hot[:, None], row, buf)
+    cnt = cnt + success.astype(jnp.int32)
+    trace = _trace_append(s.trace, success, t, job.id, node, src) if record_trace else s.trace
+    run_full = jnp.logical_and(jnp.logical_and(do, node >= 0),
+                               jnp.logical_not(has_slot))
+    drops = s.drops.replace(run_full=s.drops.run_full + run_full.astype(jnp.int32))
+    s = s.replace(node_free=free, trace=trace, drops=drops,
+                  placed_total=s.placed_total + success.astype(jnp.int32))
+    return s, success, buf, cnt
+
+
+def _sweep_len(cfg: SimConfig) -> int:
+    """Per-tick placement-sweep length: the whole queue in parity mode, the
+    fast-mode cap otherwise (PARITY.md §divergences)."""
+    if cfg.parity:
+        return cfg.queue_capacity
+    return min(cfg.queue_capacity, cfg.max_placements_per_tick)
+
+
+def _record_wait(total, rec_wait, enq_t, t, do):
+    """JobsMap bookkeeping on a scheduling attempt (scheduler.go:309-312):
+    TotalTime -= map[id]; map[id] = since(enqueue); TotalTime += map[id]."""
+    cur = (t - enq_t).astype(jnp.int32)
+    delta = jnp.where(do, (cur - rec_wait).astype(jnp.float32), 0.0)
+    return total + delta, jnp.where(do, cur, rec_wait)
+
+
+def _max_wait_ms(cfg: SimConfig, params):
+    """The DELAY Level0->Level1 promotion threshold: a policy parameter
+    (traced leaf) when params are given, the config constant otherwise —
+    bitwise the same compare either way for the config-derived default."""
+    if params is None:
+        return jnp.int32(cfg.max_wait_ms)
+    return params.max_wait_ms.astype(jnp.int32)
+
+
+def _bfd_order(q, params):
+    """Best-fit-decreasing slot order with the FFD tie-break as data:
+    ``params.ffd_mem_first`` swaps the (cores, mem) sort-key priority.
+    ``params=None`` (and the default 0) is exactly
+    ``P.best_fit_decreasing_order`` — the seed FFD semantics."""
+    if params is None:
+        return P.best_fit_decreasing_order(q.cores, q.mem, q.slot_valid())
+    valid = q.slot_valid()
+    big = jnp.int32(2**31 - 1)
+    mem_first = params.ffd_mem_first > 0
+    primary = jnp.where(valid, jnp.where(mem_first, -q.mem, -q.cores), big)
+    secondary = jnp.where(valid, jnp.where(mem_first, -q.cores, -q.mem), big)
+    return jnp.lexsort((secondary, primary)).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# DELAY — the reference's live algorithm
+# --------------------------------------------------------------------------
+
+def _delay_local(s: SimState, t, cfg: SimConfig, params=None):
+    """Delay() — the reference's live algorithm (scheduler.go:298-369).
+
+    In fast mode (parity=False) the Level1 sweep attempts only the first
+    ``max_placements_per_tick`` queue slots — a throughput knob for scale
+    configs (PARITY.md §divergences); the queue still drains in FIFO order
+    via compaction."""
+    QC = _sweep_len(cfg)
+
+    # ---- Level1 sweep: a bounded while loop — under vmap it runs only
+    # max-over-clusters(|Level1|) iterations, so an idle constellation pays
+    # ~nothing and parity mode costs the same as the capped fast mode.
+    # RunningSet insertions are deferred to one start_many after the loop
+    # (_attempt_deferred) — the per-step body touches only [SW]-sized
+    # scratch, not the [S]-sized set ----
+    n_sweep = jnp.minimum(s.l1.count, QC)
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
+
+    def cond(carry):
+        s2, i, rec, placed, skip_next, buf, cnt = carry
+        return i < n_sweep
+
+    def step(carry):
+        s2, i, rec, placed, skip_next, buf, cnt = carry
+        process = jnp.logical_and(i < n_sweep, jnp.logical_not(skip_next))
+        # one-hot slot access: dynamic row gathers/scatters serialize when
+        # the loop body is vmapped over thousands of clusters
+        hot = jnp.arange(s2.l1.capacity, dtype=jnp.int32) == i
+        rec_i = jnp.einsum("q,q->", hot.astype(jnp.int32), rec)
+        job = Q.select_row(s2.l1, hot).with_(rec_wait=rec_i)
+        total, new_rec = _record_wait(s2.wait_total, rec_i, job.enq_t, t, process)
+        rec = jnp.where(jnp.logical_and(hot, process), new_rec, rec)
+        s2 = s2.replace(wait_total=total)
+        s2, success, buf, cnt = _attempt_deferred(
+            s2, job, t, process, st.SRC_L1, cfg.record_trace, buf, cnt, n_active)
+        s2 = s2.replace(jobs_in_queue=s2.jobs_in_queue - success.astype(jnp.int32))
+        placed = jnp.logical_or(placed, jnp.logical_and(hot, success))
+        # Parity: Go removes L1[i] in place and `i++` skips the element that
+        # slides into position i (scheduler.go:319) — equivalent on the
+        # original order to "after a success, skip the next element".
+        skip_next = success if cfg.parity else jnp.zeros((), bool)
+        return (s2, i + 1, rec, placed, skip_next, buf, cnt)
+
+    init = (s, jnp.int32(0), s.l1.rec_wait,
+            jnp.zeros((cfg.queue_capacity,), bool), jnp.zeros((), bool),
+            jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0))
+    t_in = s.t
+    s, _, rec, placed, _, buf, cnt = jax.lax.while_loop(cond, step, init)
+    # the loop never writes the clock, but under vmap a batched loop
+    # predicate makes older jax batching rules batch EVERY carry leaf —
+    # including the replicated scalar t, which then trips the engine's
+    # out_axes=None spec. Restoring the pre-loop leaf is a semantic no-op
+    # that keeps t replicated on every jax version.
+    s = s.replace(t=t_in)
+    l1 = Q.compact(Q.set_field(s.l1, "rec_wait", rec), jnp.logical_not(placed))
+    s = s.replace(l1=l1, run=R.start_many(s.run, buf, cnt))
+    return _delay_l0_head(s, t, cfg, params)
+
+
+def _delay_l0_head(s: SimState, t, cfg: SimConfig, params=None):
+    """The Level0-head half of Delay() (scheduler.go:332-366): one
+    placement attempt on the head, else promote to Level1 after
+    MaxWaitTime. Shared by the serial and wave Level1 sweeps."""
+    process = s.l0.count > 0
+    job = Q.head(s.l0)
+    total, new_rec = _record_wait(s.wait_total, job.rec_wait, job.enq_t, t, process)
+    l0 = Q.set_field_elem(s.l0, "rec_wait", 0, new_rec)
+    s = s.replace(wait_total=total, l0=l0)
+    job = job.with_(rec_wait=new_rec)
+    s, success = _attempt(s, job, t, process, st.SRC_L0, cfg.record_trace)
+    s = s.replace(jobs_in_queue=s.jobs_in_queue - success.astype(jnp.int32))
+    promote = jnp.logical_and(
+        jnp.logical_and(process, jnp.logical_not(success)),
+        (t - job.enq_t) >= _max_wait_ms(cfg, params),
+    )
+    s = s.replace(
+        l0=Q.pop_front(s.l0, jnp.logical_or(success, promote)),
+        l1=Q.push_back(s.l1, job, promote),
+        drops=s.drops.replace(
+            queue=s.drops.queue + Q.push_back_dropped(s.l1, promote)),
+    )
+    return s
+
+
+def _delay_wave_local(s: SimState, t, cfg: SimConfig, params=None):
+    """Fast-mode Delay(): the Level1 sweep as speculative waves
+    (``_wave_place``; equivalence argument in ``_ffd_wave_local``) plus
+    the shared Level0-head attempt. Parity mode keeps the serial sweep —
+    its remove-then-skip quirk and ordered float wait accumulation are
+    part of bit-parity (PARITY.md)."""
+    QC = min(cfg.queue_capacity, cfg.max_placements_per_tick)
+    n_sweep = jnp.minimum(s.l1.count, QC)
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
+    act0 = jnp.arange(QC, dtype=jnp.int32) < n_sweep
+    rows = Q.rows_prefix(s.l1, QC)  # sweep order == queue order (no sort)
+    jobs = Q.JobRec(vec=rows)
+
+    # wait accounting, vectorized over the processed prefix (fast mode:
+    # no serial-float-order constraint)
+    processed_slot = s.l1.slot_valid() & (
+        jnp.arange(s.l1.capacity, dtype=jnp.int32) < n_sweep)
+    cur = (t - s.l1.enq_t).astype(jnp.int32)
+    frec = s.l1.rec_wait
+    delta = jnp.where(processed_slot, (cur - frec).astype(jnp.float32), 0.0)
+    l1 = Q.set_field(s.l1, "rec_wait", jnp.where(processed_slot, cur, frec))
+    s = s.replace(wait_total=s.wait_total + delta.sum(), l1=l1)
+
+    free, node_sel, cnt, run_full = _wave_place(
+        s.node_free, s.node_active, s.run.capacity, n_active, jobs, act0)
+
+    placed_pos = node_sel >= jnp.int32(0)
+    all_rows = jax.vmap(lambda v, n: R.row_from_job(Q.JobRec(vec=v), n, t)
+                        )(rows, node_sel)
+    rankp = jnp.cumsum(placed_pos.astype(jnp.int32)) - 1
+    bhot = jnp.logical_and(
+        placed_pos[:, None],
+        rankp[:, None] == jnp.arange(QC, dtype=jnp.int32)[None, :],
+    ).astype(jnp.int32)
+    buf = jnp.einsum("kb,kf->bf", bhot, all_rows)
+    trace = s.trace
+    if cfg.record_trace:
+        trace = _trace_append_many(trace, placed_pos, t, jobs.id, node_sel,
+                                   st.SRC_L1)
+    placed_slot = jnp.pad(placed_pos, (0, s.l1.capacity - QC))
+    s = s.replace(
+        node_free=free, trace=trace,
+        drops=s.drops.replace(run_full=s.drops.run_full + run_full),
+        placed_total=s.placed_total + cnt,
+        jobs_in_queue=s.jobs_in_queue - cnt,
+        l1=Q.compact(s.l1, jnp.logical_not(placed_slot)),
+        run=R.start_many(s.run, buf, cnt))
+    return _delay_l0_head(s, t, cfg, params)
+
+
+# --------------------------------------------------------------------------
+# FFD — first-fit-decreasing bin-pack (TPU-side upgrade)
+# --------------------------------------------------------------------------
+
+def _ffd_local(s: SimState, t, cfg: SimConfig, params=None):
+    """First-fit-decreasing bin-pack over Level0 — one XLA sort + the shared
+    placement sweep (``_scored_sweep_local`` with the default first-fit
+    node pick). Not in the reference; BASELINE.json config 3. Fast mode
+    caps the sweep at ``max_placements_per_tick`` (largest jobs first)."""
+    return _scored_sweep_local(s, t, cfg, params, _bfd_order(s.l0, params),
+                               score_fn=None)
+
+
+# --------------------------------------------------------------------------
+# speculative-wave machinery (shared by the FFD/DELAY/FIFO wave forms)
+# --------------------------------------------------------------------------
+
+def _wave_probe(free, node_active, jobs: Q.JobRec, active):
+    """The per-wave feasibility core shared by every speculative sweep
+    (``_wave_place``, ``_fifo_drain_wave``): first-fit target selection and
+    cumulative-overflow detection for the active rows under the current
+    ``free``. This is the equivalence-critical logic — any edit here changes
+    all wave forms together (tests/test_kernel_equiv.py pins wave==serial).
+
+    A wave accepts *whole same-target groups*, not just distinct targets:
+    for jobs targeting the same node, the running group total (job k's own
+    demand plus all earlier same-target rows) is compared against the
+    node's free vector, and only the row that overflows it (and everything
+    after, via the callers' prefix rules) defers to the next wave. This is
+    exact by the same monotonicity argument as the original
+    distinct-target rule (``_ffd_wave_local`` docstring), extended one
+    step: for an accepted job k targeting node n, earlier accepted jobs on
+    other nodes leave n untouched, earlier accepted jobs ON n are exactly
+    k's group predecessors — whose total including k fits — so when the
+    serial sweep reaches k, nodes before n are still infeasible (free only
+    shrinks) and n is still feasible: the serial sweep picks n too. Without
+    the group rule, homogeneous clusters degrade to one placement per wave
+    (every queued job first-fits the same node), which left the FIFO
+    headline latency-bound at ~backlog iterations per tick.
+
+    Returns ``(feas_any, tgt, tgt_hot, overflow)``: per-row feasibility,
+    first-fit node index, its one-hot [QC, N] form (zero rows where
+    infeasible/inactive), and whether the row's cumulative group demand
+    overflows its target's free capacity this wave."""
+    feas = jax.vmap(lambda c, m, g: P.feasible(
+        free, node_active, c, m, g))(jobs.cores, jobs.mem, jobs.gpu)
+    feas = jnp.logical_and(feas, active[:, None])  # [QC, N]
+    feas_any = jnp.any(feas, axis=-1)
+    tgt = jnp.argmax(feas, axis=-1).astype(jnp.int32)  # first-fit node
+    tgt_hot = jnp.logical_and(
+        feas_any[:, None],
+        tgt[:, None] == jnp.arange(feas.shape[1],
+                                   dtype=jnp.int32)[None, :],
+    ).astype(jnp.int32)
+    res = jobs.res[..., : free.shape[-1]]  # [QC, R]
+    cum = jnp.cumsum(tgt_hot[:, :, None] * res[:, None, :], axis=0)  # [QC, N, R]
+    group_dem = jnp.einsum("kn,knr->kr", tgt_hot, cum)  # incl. the row itself
+    tgt_free = jnp.einsum("kn,nr->kr", tgt_hot, free)
+    overflow = jnp.logical_and(feas_any,
+                               jnp.any(group_dem > tgt_free, axis=-1))
+    return feas_any, tgt, tgt_hot, overflow
+
+
+def _wave_occupy(free, tgt_hot, place, jobs: Q.JobRec):
+    """Subtract the accepted rows' resources from ``free``: one [QC, N] x
+    [QC, R] contraction instead of per-row scatter-subtracts."""
+    used = jnp.einsum("kn,kr->nr", tgt_hot * place[:, None].astype(jnp.int32),
+                      jobs.res[..., : free.shape[-1]])
+    return free - used
+
+
+def _wave_place(free0, node_active, run_cap, n_active, jobs: Q.JobRec, act0):
+    """The wave-placement core shared by the FFD and DELAY fast-mode
+    sweeps: place ``jobs`` (a [QC]-batched JobRec in sweep order, active
+    where ``act0``) by speculative conflict-free-prefix waves. Returns
+    ``(free', node_sel, cnt, run_full)`` with ``node_sel[k]`` the placed
+    node per position (NO_NODE where unplaced). Equivalence argument:
+    ``_ffd_wave_local`` docstring."""
+    QC = act0.shape[0]
+
+    def cond(carry):
+        free, resolved, node_sel, cnt, run_full = carry
+        return jnp.any(jnp.logical_and(act0, jnp.logical_not(resolved)))
+
+    def step(carry):
+        free, resolved, node_sel, cnt, run_full = carry
+        active = jnp.logical_and(act0, jnp.logical_not(resolved))
+        feas_any, tgt, tgt_hot, overflow = _wave_probe(free, node_active,
+                                                       jobs, active)
+        blocked = jnp.cumsum(overflow.astype(jnp.int32)) > 0  # self included
+        place_try = jnp.logical_and(feas_any, jnp.logical_not(blocked))
+        rank = jnp.cumsum(place_try.astype(jnp.int32)) - 1
+        has_slot = (n_active + cnt + rank) < run_cap
+        place = jnp.logical_and(place_try, has_slot)
+        slot_full = jnp.logical_and(place_try, jnp.logical_not(has_slot))
+        # infeasible-now is infeasible-forever (free only shrinks): resolve
+        # failed even past the block point; slot-exhausted jobs resolve too
+        # (run_full drop), exactly as the serial sweep counts them
+        resolved = jnp.logical_or(
+            resolved, jnp.logical_or(
+                place, jnp.logical_or(
+                    slot_full,
+                    jnp.logical_and(active, jnp.logical_not(feas_any)))))
+        free = _wave_occupy(free, tgt_hot, place, jobs)
+        node_sel = jnp.where(place, tgt, node_sel)
+        cnt = cnt + place.sum().astype(jnp.int32)
+        run_full = run_full + slot_full.sum().astype(jnp.int32)
+        return free, resolved, node_sel, cnt, run_full
+
+    free, _, node_sel, cnt, run_full = jax.lax.while_loop(
+        cond, step, (free0, jnp.logical_not(act0),
+                     jnp.full((QC,), P.NO_NODE), jnp.int32(0), jnp.int32(0)))
+    return free, node_sel, cnt, run_full
+
+
+def _ffd_wave_local(s: SimState, t, cfg: SimConfig, params=None):
+    """``_ffd_local`` restructured as speculative placement waves — same
+    placements, a fraction of the serial steps.
+
+    Sequential first-fit has a loop-carried dependency (each placement
+    shrinks ``free`` for the next job), which on TPU costs one
+    latency-bound while_loop iteration per queued job, maxed over all
+    vmapped clusters (tools/cost_probe.json: the FFD sweep achieves less
+    than half the headline's HBM bandwidth). The wave form places many
+    jobs per iteration and is *provably identical* to the serial sweep:
+
+    each wave, every unresolved job computes its first-fit target under
+    the current ``free``; the accepted set is the longest prefix (in FFD
+    order) in which every job's cumulative same-target group demand fits
+    its target node (``_wave_probe`` — whole groups land in one wave).
+    For an accepted job, earlier accepted jobs on other nodes leave its
+    target untouched, earlier accepted jobs on the SAME node are its
+    group predecessors whose total including it fits, and ``free`` only
+    ever shrinks — so nodes before its target stay infeasible and its
+    target stays feasible: exactly the node the serial sweep would pick.
+    A job infeasible under the current ``free`` is infeasible forever
+    (monotonicity) and resolves as failed immediately; the first
+    group-capacity overflow defers itself and everything after it to the
+    next wave. The earliest unresolved job can never overflow (it is
+    feasible and heads its group), so every wave makes progress and the
+    loop runs one iteration per capacity epoch instead of one per job.
+
+    Used in fast mode (``parity=False`` — the Go reference has no FFD, so
+    there is no Go-semantics constraint either way; ``ffd_sweep="serial"``
+    keeps the old path, and tests/test_kernel_equiv.py pins wave == serial
+    on trace, queue, and node state across seeds)."""
+    QC = min(cfg.queue_capacity, cfg.max_placements_per_tick)
+    cap_q = s.l0.capacity
+    order = _bfd_order(s.l0, params)[:QC]  # [QC]
+    n_sweep = jnp.minimum(s.l0.count, QC)
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
+    act0 = jnp.arange(QC, dtype=jnp.int32) < n_sweep
+
+    # ordered job rows: one [QC, Q] @ [Q, NF] integer contraction
+    sel = (order[:, None] ==
+           jnp.arange(cap_q, dtype=jnp.int32)[None, :]).astype(jnp.int32)
+    rows = Q.gather_rows(s.l0, sel)
+    jobs = Q.JobRec(vec=rows)
+
+    # wait accounting, vectorized at the slot level (every processed job is
+    # recorded exactly once per tick; fast mode has no serial-float-order
+    # constraint — parity mode keeps the serial sweep)
+    processed_slot = jnp.einsum("kq,k->q", sel, act0.astype(jnp.int32)) > 0
+    cur = (t - s.l0.enq_t).astype(jnp.int32)
+    frec = s.l0.rec_wait
+    delta = jnp.where(processed_slot, (cur - frec).astype(jnp.float32), 0.0)
+    l0 = Q.set_field(s.l0, "rec_wait", jnp.where(processed_slot, cur, frec))
+    s = s.replace(wait_total=s.wait_total + delta.sum(), l0=l0)
+
+    free, node_sel, cnt, run_full = _wave_place(
+        s.node_free, s.node_active, s.run.capacity, n_active, jobs, act0)
+
+    placed_pos = node_sel >= jnp.int32(0)  # [QC], in FFD order
+    # runset rows in position order, compacted to the buffer prefix
+    all_rows = jax.vmap(lambda v, n: R.row_from_job(Q.JobRec(vec=v), n, t)
+                        )(rows, node_sel)
+    rankp = jnp.cumsum(placed_pos.astype(jnp.int32)) - 1
+    bhot = jnp.logical_and(
+        placed_pos[:, None],
+        rankp[:, None] == jnp.arange(QC, dtype=jnp.int32)[None, :],
+    ).astype(jnp.int32)  # [QC, QC]
+    buf = jnp.einsum("kb,kf->bf", bhot, all_rows)
+    trace = s.trace
+    if cfg.record_trace:
+        trace = _trace_append_many(trace, placed_pos, t, jobs.id, node_sel,
+                                   st.SRC_L0)
+    placed_slot = jnp.einsum("kq,k->q", sel, placed_pos.astype(jnp.int32)) > 0
+    return s.replace(
+        node_free=free, trace=trace,
+        drops=s.drops.replace(run_full=s.drops.run_full + run_full),
+        placed_total=s.placed_total + cnt,
+        jobs_in_queue=s.jobs_in_queue - cnt,
+        l0=Q.compact(s.l0, jnp.logical_not(placed_slot)),
+        run=R.start_many(s.run, buf, cnt))
+
+
+# --------------------------------------------------------------------------
+# FIFO — wait-head / ready-drain / lent best-effort
+# --------------------------------------------------------------------------
+
+def _fifo_drain_wave(s: SimState, t, cfg: SimConfig, wait_active, n_active,
+                     QC: int):
+    """The FIFO ready drain (place from the head until the first failure)
+    as speculative waves — same outcome as the serial loop in
+    ``_fifo_local``, a fraction of the while_loop iterations.
+
+    The equivalence argument mirrors ``_ffd_wave_local`` (prefix-restricted
+    group acceptance via ``_wave_probe``; free only shrinks, so accepted
+    first-fit targets and observed infeasibilities are both stable), with
+    one extra rule for the drain-stops-at-first-failure semantics: each
+    wave accepts candidates only up to the first *breaker* — a group
+    capacity overflow (defer to the next wave), an infeasible job, or a
+    run-slot-exhausted job (both of the latter ARE the drain's failing
+    job: it pops to the wait queue and the drain stops). Unlike the FFD
+    sweep this is exact in parity mode too — the drain body performs no
+    order-sensitive float accumulation (wait recording happens at the
+    wait-head attempt, not here)."""
+    ready = s.ready
+    n_sweep = jnp.where(wait_active, 0,
+                        jnp.minimum(ready.count, QC)).astype(jnp.int32)
+    pos = jnp.arange(QC, dtype=jnp.int32)
+    act0 = pos < n_sweep
+    rows = Q.rows_prefix(ready, QC)  # queue order: position == slot
+    jobs = Q.JobRec(vec=rows)
+
+    def cond(carry):
+        free, resolved, node_sel, cnt, run_full, stopped, fail_idx = carry
+        return jnp.logical_and(
+            jnp.logical_not(stopped),
+            jnp.any(jnp.logical_and(act0, jnp.logical_not(resolved))))
+
+    def step(carry):
+        free, resolved, node_sel, cnt, run_full, stopped, fail_idx = carry
+        active = jnp.logical_and(act0, jnp.logical_not(resolved))
+        feas_any, tgt, tgt_hot, overflow = _wave_probe(free, s.node_active,
+                                                       jobs, active)
+        infeas = jnp.logical_and(active, jnp.logical_not(feas_any))
+        cand = jnp.logical_and(feas_any, jnp.logical_not(overflow))
+        r = jnp.cumsum(cand.astype(jnp.int32)) - cand.astype(jnp.int32)
+        cap_left = s.run.capacity - n_active - cnt
+        slotviol = jnp.logical_and(cand, r >= cap_left)
+        breaker = jnp.logical_or(overflow, jnp.logical_or(infeas, slotviol))
+        # positions strictly before the first breaker
+        before_break = jnp.cumsum(breaker.astype(jnp.int32)) == 0
+        place = jnp.logical_and(cand, before_break)
+        any_break = jnp.any(breaker)
+        b = jnp.argmax(breaker).astype(jnp.int32)  # first breaker position
+        b_hot = jnp.logical_and(pos == b, any_break)
+        failed = jnp.logical_and(
+            any_break,
+            jnp.logical_or(jnp.any(jnp.logical_and(b_hot, infeas)),
+                           jnp.any(jnp.logical_and(b_hot, slotviol))))
+        run_full = run_full + jnp.any(
+            jnp.logical_and(b_hot, slotviol)).astype(jnp.int32)
+        resolved = jnp.logical_or(resolved,
+                                  jnp.logical_or(place,
+                                                 jnp.logical_and(b_hot, failed)))
+        free = _wave_occupy(free, tgt_hot, place, jobs)
+        node_sel = jnp.where(place, tgt, node_sel)
+        cnt = cnt + place.sum().astype(jnp.int32)
+        stopped = jnp.logical_or(stopped, failed)
+        fail_idx = jnp.where(failed, b, fail_idx)
+        return free, resolved, node_sel, cnt, run_full, stopped, fail_idx
+
+    free, resolved, node_sel, cnt, run_full, stopped, fail_idx = \
+        jax.lax.while_loop(cond, step, (
+            s.node_free, jnp.logical_not(act0), jnp.full((QC,), P.NO_NODE),
+            jnp.int32(0), jnp.int32(0), jnp.zeros((), bool), jnp.int32(-1)))
+
+    placed_pos = node_sel >= jnp.int32(0)
+    n_taken = cnt + stopped.astype(jnp.int32)  # pops include the failure
+    fhot = (pos == fail_idx).astype(jnp.int32)
+    fail_job = Q.JobRec(vec=jnp.einsum("k,kf->f", fhot, rows))
+    all_rows = jax.vmap(lambda v, n: R.row_from_job(Q.JobRec(vec=v), n, t)
+                        )(rows, node_sel)
+    rankp = jnp.cumsum(placed_pos.astype(jnp.int32)) - 1
+    bhot = jnp.logical_and(
+        placed_pos[:, None],
+        rankp[:, None] == jnp.arange(QC, dtype=jnp.int32)[None, :],
+    ).astype(jnp.int32)
+    buf = jnp.einsum("kb,kf->bf", bhot, all_rows)
+    trace = s.trace
+    if cfg.record_trace:
+        trace = _trace_append_many(trace, placed_pos, t, jobs.id, node_sel,
+                                   st.SRC_READY)
+    s = s.replace(node_free=free, trace=trace,
+                  drops=s.drops.replace(run_full=s.drops.run_full + run_full),
+                  placed_total=s.placed_total + cnt)
+    return s, n_taken, fail_job, stopped, buf, cnt
+
+
+def _fifo_local(s: SimState, t, cfg: SimConfig, params=None):
+    """Fifo() (scheduler.go:216-296) as ordered masked phases; see PARITY.md
+    for the derivation of the per-tick semantics from the Go loop's
+    sleep/continue structure. Returns (state, borrow_want, borrow_job).
+
+    Fast mode (parity=False) caps the ready drain at
+    ``max_placements_per_tick`` steps — identical semantics whenever fewer
+    than that many jobs would drain in one tick (PARITY.md §divergences)."""
+    QC = _sweep_len(cfg)
+    wait_active = s.wait.count > 0
+
+    # ---- ready drain (only when the wait queue is empty): place from the
+    # head until the first failure; the failing job moves to WaitQueue.
+    # Bounded while loop — exits as soon as every cluster drained/stopped ----
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
+
+    def dcond(carry):
+        s2, i, stopped, n_taken, fail_job, any_fail, buf, cnt = carry
+        return jnp.logical_and(
+            jnp.logical_not(wait_active),
+            jnp.logical_and(i < jnp.minimum(s2.ready.count, QC),
+                            jnp.logical_not(stopped)))
+
+    def dstep(carry):
+        s2, i, stopped, n_taken, fail_job, any_fail, buf, cnt = carry
+        process = jnp.logical_and(
+            jnp.logical_not(wait_active),
+            jnp.logical_and(i < jnp.minimum(s2.ready.count, QC),
+                            jnp.logical_not(stopped)))
+        hot = jnp.arange(s2.ready.capacity, dtype=jnp.int32) == i
+        job = Q.select_row(s2.ready, hot)
+        s2, success, buf, cnt = _attempt_deferred(
+            s2, job, t, process, st.SRC_READY, cfg.record_trace, buf, cnt,
+            n_active)
+        fail = jnp.logical_and(process, jnp.logical_not(success))
+        n_taken = n_taken + process.astype(jnp.int32)  # pops regardless of outcome
+        fail_job = jax.tree.map(lambda a, b: jnp.where(fail, b, a), fail_job, job)
+        return (s2, i + 1, jnp.logical_or(stopped, fail), n_taken, fail_job,
+                jnp.logical_or(any_fail, fail), buf, cnt)
+
+    if cfg.fifo_drain == "wave":
+        s, n_taken, fail_job, any_fail, buf, cnt = _fifo_drain_wave(
+            s, t, cfg, wait_active, n_active, QC)
+    else:
+        init = (s, jnp.int32(0), jnp.zeros((), bool), jnp.int32(0),
+                Q.JobRec.invalid(), jnp.zeros((), bool),
+                jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0))
+        t_in = s.t
+        s, _, _, n_taken, fail_job, any_fail, buf, cnt = jax.lax.while_loop(
+            dcond, dstep, init)
+        # keep the replicated clock out of the batched carry (_delay_local)
+        s = s.replace(t=t_in)
+    # the drain consumes a strict prefix of the ready queue; its placements
+    # flush into the set before the wait-head attempt reads occupancy
+    s = s.replace(run=R.start_many(s.run, buf, cnt),
+                  ready=Q.pop_front_n(s.ready, n_taken),
+                  wait=Q.push_back(s.wait, fail_job, any_fail),
+                  drops=s.drops.replace(
+                      queue=s.drops.queue + Q.push_back_dropped(s.wait, any_fail)))
+
+    # ---- wait-head attempt (the branch at scheduler.go:219-252) ----
+    process_w = s.wait.count > 0
+    wjob = Q.head(s.wait)
+    s, wsuccess = _attempt(s, wjob, t, process_w, st.SRC_WAIT, cfg.record_trace)
+    s = s.replace(wait=Q.pop_front(s.wait, wsuccess))
+    borrow_want = jnp.logical_and(process_w, jnp.logical_not(wsuccess))
+    if not cfg.borrowing:
+        borrow_want = jnp.zeros((), bool)
+
+    # ---- lent best-effort (scheduler.go:277-291): reached only in a tick
+    # where wait was empty and ready drained clean ----
+    lent_ok = jnp.logical_and(
+        jnp.logical_and(jnp.logical_not(wait_active), jnp.logical_not(any_fail)),
+        jnp.logical_and(s.ready.count == 0, s.lent.count > 0))
+    ljob = Q.head(s.lent)
+    s, lsuccess = _attempt(s, ljob, t, lent_ok, st.SRC_LENT, cfg.record_trace)
+    s = s.replace(lent=Q.pop_front(s.lent, lsuccess))
+    return s, borrow_want, wjob
+
+
+# --------------------------------------------------------------------------
+# GAVEL — round-based heterogeneity-aware placement (arxiv 2008.09213)
+# --------------------------------------------------------------------------
+
+def _gavel_scores(node_type, jclass, params):
+    """[N] per-node throughput for a job of class ``jclass``: one row of the
+    policy's [N_JOB_CLASSES, N_DEVICE_TYPES] throughput matrix, spread over
+    the node slots by device type. One-hot contractions, no gathers (the
+    kernel is vmapped over thousands of clusters)."""
+    jc = jnp.clip(jclass, 0, F.N_JOB_CLASSES - 1)
+    row_hot = (jnp.arange(F.N_JOB_CLASSES, dtype=jnp.int32) == jc)
+    row = jnp.einsum("c,cd->d", row_hot.astype(jnp.float32),
+                     params.gavel_tput)  # [DT]
+    nt = jnp.clip(node_type, 0, F.N_DEVICE_TYPES - 1)
+    nt_hot = (nt[:, None] ==
+              jnp.arange(F.N_DEVICE_TYPES, dtype=jnp.int32)[None, :])
+    return jnp.einsum("nd,d->n", nt_hot.astype(jnp.float32), row)  # [N]
+
+
+def _tesserae_scores(node_free, job, params):
+    """[N] packing-alignment score: the Tetris/Tesserae demand·free dot
+    product under ``params.tess_w`` resource weights — high where the
+    node's remaining shape matches the job's demand shape, so complementary
+    jobs pack onto the same node instead of fragmenting first-fit order."""
+    n_res = node_free.shape[-1]
+    res = job.res[..., :n_res].astype(jnp.float32)  # [R]
+    w = params.tess_w[:n_res]
+    return jnp.einsum("nr,r->n", node_free.astype(jnp.float32), res * w)
+
+
+def _scored_sweep_local(s: SimState, t, cfg: SimConfig, params, order,
+                        score_fn):
+    """The ONE serial Level0 placement sweep behind FFD, gavel, and
+    tesserae: a bounded while loop over ``order`` with one-hot slot access
+    (see ``_delay_local``), per-slot wait recording, deferred RunningSet
+    insertion, and queue compaction — shared, so the zoo members differ
+    ONLY in sweep order and target selection and accounting can never
+    drift per policy. ``score_fn(state, job) -> [N]`` swaps the node pick
+    for ``P.best_scored_fit``; ``None`` keeps the reference first-fit
+    (``_attempt_deferred``'s default)."""
+    QC = _sweep_len(cfg)
+    n_sweep = jnp.minimum(s.l0.count, QC)  # order puts valid slots first
+    n_active = jnp.sum(s.run.active).astype(jnp.int32)
+
+    def cond(carry):
+        s2, k, placed, buf, cnt = carry
+        return k < n_sweep
+
+    def step(carry):
+        s2, k, placed, buf, cnt = carry
+        process = k < n_sweep
+        # one-hot slot access (see _delay_local): i = order[k], then row i
+        cap = s2.l0.capacity
+        hot_k = jnp.arange(cap, dtype=jnp.int32) == k
+        i = jnp.einsum("q,q->", hot_k.astype(jnp.int32), order)
+        hot = jnp.arange(cap, dtype=jnp.int32) == i
+        job = Q.select_row(s2.l0, hot)
+        total, new_rec = _record_wait(s2.wait_total, job.rec_wait, job.enq_t,
+                                      t, process)
+        frec = s2.l0.rec_wait
+        frec = jnp.where(jnp.logical_and(hot, process), new_rec, frec)
+        s2 = s2.replace(wait_total=total,
+                        l0=Q.set_field(s2.l0, "rec_wait", frec))
+        node = None if score_fn is None else P.best_scored_fit(
+            s2.node_free, s2.node_active, job, score_fn(s2, job))
+        s2, success, buf, cnt = _attempt_deferred(
+            s2, job, t, process, st.SRC_L0, cfg.record_trace, buf, cnt,
+            n_active, node=node)
+        s2 = s2.replace(
+            jobs_in_queue=s2.jobs_in_queue - success.astype(jnp.int32))
+        placed = jnp.logical_or(placed, jnp.logical_and(hot, success))
+        return (s2, k + 1, placed, buf, cnt)
+
+    t_in = s.t
+    s, _, placed, buf, cnt = jax.lax.while_loop(
+        cond, step, (s, jnp.int32(0), jnp.zeros((cfg.queue_capacity,), bool),
+                     jnp.zeros((QC, R.RF), jnp.int32), jnp.int32(0)))
+    s = s.replace(t=t_in)  # keep the replicated clock unbatched (_delay_local)
+    return s.replace(l0=Q.compact(s.l0, jnp.logical_not(placed)),
+                     run=R.start_many(s.run, buf, cnt))
+
+
+def _gavel_local(s: SimState, t, cfg: SimConfig, params):
+    """Gavel-style round: sweep Level0 in queue order, each job placed on
+    the feasible node whose device type maximizes the job class's
+    throughput (ties -> lowest node index, the reference's first-fit
+    orientation). With the uniform throughput matrix this IS first-fit in
+    queue order; the matrix is the policy."""
+    order = jnp.arange(s.l0.capacity, dtype=jnp.int32)  # queue order
+
+    def score(s2, job):
+        return _gavel_scores(s2.node_type, job.jclass, params)
+
+    return _scored_sweep_local(s, t, cfg, params, order, score)
+
+
+def _tesserae_local(s: SimState, t, cfg: SimConfig, params):
+    """Tesserae-style packing pass: decreasing-demand sweep (the FFD
+    order), each job placed on the feasible node with the highest
+    weighted demand·free alignment — best-shape-fit instead of
+    first-index-fit."""
+    order = _bfd_order(s.l0, None)
+
+    def score(s2, job):
+        return _tesserae_scores(s2.node_free, job, params)
+
+    return _scored_sweep_local(s, t, cfg, params, order, score)
+
+
+# --------------------------------------------------------------------------
+# leap-accrual masks (the event-compressed driver's closed-form wait)
+# --------------------------------------------------------------------------
+
+def leap_wait_masks(kind: str, s: SimState, cfg: SimConfig, params=None):
+    """Queue slots whose wait clock the scheduling pass advances every tick
+    at a placement fixed point — exactly the slots the dense pass calls
+    ``_record_wait`` on when nothing places: (l0_mask, l1_mask), single
+    cluster view. FIFO records no wait in the pass; DELAY processes the
+    first ``min(|L1|, QC)`` Level1 slots plus the Level0 head; the Level0
+    sweeps (FFD/gavel/tesserae) record their first ``min(|L0|, QC)``
+    processed slots — in sweep order, which for the sorted sweeps means
+    the first n positions of the (possibly param-swapped) BFD order.
+    ``kind`` is the policy KIND (static — one mask shape per registered
+    kernel family, policies/base.py dispatches it)."""
+    cap0 = s.l0.capacity
+    zl1 = jnp.zeros((s.l1.capacity,), bool)
+    if kind == "fifo":
+        return jnp.zeros((cap0,), bool), zl1
+    QC = _sweep_len(cfg)
+    if kind == "delay":
+        l1_mask = jnp.logical_and(
+            s.l1.slot_valid(),
+            jnp.arange(s.l1.capacity, dtype=jnp.int32)
+            < jnp.minimum(s.l1.count, QC))
+        l0_mask = jnp.logical_and(
+            jnp.arange(cap0, dtype=jnp.int32) == 0, s.l0.count > 0)
+        return l0_mask, l1_mask
+    if kind == "gavel":
+        # queue-order sweep: the first min(|L0|, QC) slots ARE positions
+        l0_mask = jnp.logical_and(
+            s.l0.slot_valid(),
+            jnp.arange(cap0, dtype=jnp.int32) < jnp.minimum(s.l0.count, QC))
+        return l0_mask, zl1
+    # ffd / tesserae: slots selected by the first n_sweep positions of the
+    # sweep's BFD order (ffd's tie-break is a param; tesserae uses default)
+    order = _bfd_order(s.l0, params if kind == "ffd" else None)
+    n_sweep = jnp.minimum(s.l0.count, QC)
+    hot = order[:, None] == jnp.arange(cap0, dtype=jnp.int32)[None, :]
+    taken = jnp.arange(cap0, dtype=jnp.int32) < n_sweep  # order positions
+    l0_mask = jnp.any(jnp.logical_and(hot, taken[:, None]), axis=0)
+    return l0_mask, zl1

@@ -1,0 +1,14 @@
+"""Known-bad policy kernel: Python control flow on the traced params pytree
+(would bake one tournament cell's branch into every cell's program), plus a
+wall-clock read and a bare np call on traced data."""
+import numpy as np
+import jax.numpy as jnp
+import time
+
+
+def _my_policy_local(s, t, cfg, params):
+    if params.max_wait_ms > 0:  # BAD: traced branch on a policy parameter
+        s = s.replace(wait_total=s.wait_total + 1.0)
+    jitter = time.time()  # BAD: wall-clock inside a kernel
+    scores = np.maximum(s.node_free, 0)  # BAD: bare np on traced data
+    return s.replace(node_free=jnp.asarray(scores) + jnp.float32(jitter))

@@ -1,0 +1,14 @@
+"""Paired clean kernel: the same knobs read branchlessly — traced params
+steer ``jnp.where``, the only Python branches are on static config /
+pytree-structure facts (``params is None``)."""
+import jax.numpy as jnp
+
+
+def _my_policy_local(s, t, cfg, params=None):
+    max_wait = (jnp.int32(cfg.max_wait_ms) if params is None
+                else params.max_wait_ms.astype(jnp.int32))
+    overdue = (t - s.l0.enq_t) >= max_wait
+    bump = jnp.where(overdue, 1.0, 0.0).sum()
+    if cfg.parity:  # static config branch: legal
+        bump = bump * 0.0
+    return s.replace(wait_total=s.wait_total + bump)

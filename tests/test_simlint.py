@@ -68,6 +68,7 @@ FIXTURE_RULES = [
     ("bad_det_wallclock.py", "det-wallclock"),
     ("bad_det_chunk_sync.py", "det-chunk-sync"),
     ("bad_compact_store.py", "compact-store"),
+    ("bad_policy_kernel.py", "policy-kernel"),
     ("bad_pragma.py", "pragma-no-reason"),
     ("bad_pragma.py", "pragma-stale"),
 ]
@@ -131,6 +132,45 @@ def test_compact_store_reaches_the_real_soa_ops(tmp_path):
     f = tmp_path / "queues_bad.py"
     f.write_text(bad)
     assert any(x.rule == "compact-store" for x in run(str(f)))
+
+
+def test_good_policy_kernel_fixture_is_clean():
+    """The paired clean kernel — traced params steering jnp.where, static
+    config branches, and the legal ``params is None`` structure check —
+    must NOT trip policy-kernel (or anything else)."""
+    findings = run(str(FIXTURES / "good_policy_kernel.py"))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    proc = _cli(str(FIXTURES / "good_policy_kernel.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_policy_kernel_reaches_the_real_zoo(tmp_path):
+    """policy-kernel provably engages with policies/kernels.py's real code:
+    inject a Python branch on the traced params pytree into a kernel and
+    the rule must fire — table-dispatched kernels escape jit-entry
+    reachability, so this pass (not the purity family) is what guards
+    them."""
+    src = (PKG_DIR / "policies" / "kernels.py").read_text()
+    anchor = "    process = s.l0.count > 0\n"
+    bad = src.replace(
+        anchor,
+        "    process = s.l0.count > 0\n"
+        "    if params.max_wait_ms > 0:\n"
+        "        process = process & True\n", 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "kernels_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "policy-kernel" for x in run(str(f)))
+
+
+def test_policy_kernel_scopes_the_kernels_module():
+    """The family actually runs over policies/kernels.py inside the package
+    (a clean result must mean 'checked and clean', not 'not in scope')."""
+    from tools.simlint.runner import POLICY_KERNEL_FILES
+
+    modules, _ = load_target(str(PKG_DIR))
+    assert any(m.relpath in POLICY_KERNEL_FILES for m in modules), \
+        "policies/kernels.py not loaded — the policy-kernel scope is empty"
 
 
 def test_good_chunk_pipeline_fixture_is_clean():
@@ -315,10 +355,10 @@ def test_detects_injected_engine_regression(tmp_path):
     exists to protect, not only against synthetic fixtures."""
     src = (PKG_DIR / "core" / "engine.py").read_text()
     bad = src.replace(
-        "    process = s.l0.count > 0\n",
-        "    process = s.l0.count > 0\n"
-        "    if s.wait_total > 0:\n"
-        "        process = process & True\n", 1)
+        "    n = jnp.sum(elig).astype(jnp.int32)\n",
+        "    n = jnp.sum(elig).astype(jnp.int32)\n"
+        "    if n > 0:\n"
+        "        n = n + 0\n", 1)
     assert bad != src, "anchor line moved; update this test"
     f = tmp_path / "engine_bad.py"
     f.write_text(bad)

@@ -151,6 +151,7 @@ def probe_layout(cfg, n_ticks, specs, arr, plan):
     intensity = flops / bytes_acc if bytes_acc else float("nan")
     drops = total_drops(out)
     out_row = {
+        "policy": eng.policy_provenance(),
         "tick_flops": flops, "tick_bytes_accessed": bytes_acc,
         "xla_cost_model_bytes": cost_model_bytes,
         "tick_temp_bytes": temp_bytes,

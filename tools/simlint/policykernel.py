@@ -1,0 +1,77 @@
+"""policy-kernel pass: the policy zoo's kernels must be pure traced code.
+
+The scheduling-pass kernels (policies/kernels.py) are dispatched through
+``lax.switch`` tables and ``vmap`` wrappers, which the call-graph's
+jit-entry reachability can legitimately miss — so the purity family's
+"reachable from jit" scoping is the wrong gate here. This pass applies the
+SAME node checks as the purity pass (tools/simlint/purity.py: traced
+branches, wall-clock/RNG, host coercions, bare ``np.`` on traced data,
+64-bit dtypes) to EVERY function in the kernels module, reachable or not,
+under one family rule id ``policy-kernel``.
+
+The extra obligation the family exists for: kernels receive their policy's
+knobs as a TRACED ``PolicyParams`` pytree (policy-as-data — the vmapped
+tournament batches it), so Python control flow on ``params`` is a
+correctness bug, not a style issue: it would bake one tournament cell's
+branch into every cell's compiled program. ``params is None`` stays legal
+(pytree structure is a trace-time fact); ``if params.max_wait_ms > 0`` is
+the canonical violation (tests/fixtures/simlint/bad_policy_kernel.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint import purity
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+# parameters that carry static registry/config objects into kernels and
+# dispatch plumbing (policies/base.py PolicySpec; the kind strings the
+# leap-mask table switches on) — Python branching on them is trace-time
+_EXTRA_STATIC_PARAMS = ("spec", "kind", "pset")
+
+
+def module_takes_params(mod: Module) -> bool:
+    """Does any function in the module carry the kernel signature's traced
+    ``params`` argument? Single-file targets match every scope by
+    convention, so the runner applies this family to standalone files only
+    when they actually look like policy kernels — otherwise every fixture
+    of every other family would pick up duplicate purity findings."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            if any(arg.arg == "params"
+                   for arg in a.posonlyargs + a.args + a.kwonlyargs):
+                return True
+    return False
+
+
+def check_module(mod: Module) -> list[Finding]:
+    raw: set[tuple] = set()
+    np_aliases = purity._np_alias_set(mod)
+    random_aliases = frozenset(
+        {a for a, m in mod.module_aliases.items() if m == "random"} | {
+            a for a, (src, orig) in mod.from_imports.items()
+            if src == "numpy" and orig == "random"})
+
+    # every top-level function and method; nested defs are walked as part
+    # of their parent (same jit program)
+    def visit(node, inside_fn):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not inside_fn:
+                    tainter = purity._Tainter(child)
+                    for name in _EXTRA_STATIC_PARAMS:
+                        if name in tainter.env:
+                            tainter.env[name] = False
+                    for n in ast.walk(child):
+                        purity._check_node(n, tainter, np_aliases,
+                                           random_aliases, raw)
+                visit(child, True)
+            else:
+                visit(child, inside_fn)
+
+    visit(mod.tree, False)
+    return [Finding(mod.path, line, "policy-kernel", f"[{rule}] {msg}")
+            for (line, rule, msg) in sorted(raw)]

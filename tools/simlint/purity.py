@@ -37,10 +37,14 @@ STATIC_PARAM_NAMES = frozenset({
     # storage dtypes are trace-time Python values (np.dtype objects from a
     # CompactPlan's static table — core/compact.py)
     "dtype", "dtypes",
+    # the compiled policy repertoire is a static registry object
+    # (policies.PolicySet) — only its params pytree is traced
+    "pset",
 })
 STATIC_ANNOTATIONS = frozenset({
     "int", "bool", "str", "float", "SimConfig", "TraderConfig",
-    "WorkloadConfig", "PolicyKind", "MatchKind", "Mesh",
+    "WorkloadConfig", "PolicyKind", "MatchKind", "Mesh", "PolicySet",
+    "PolicySpec",
 })
 # attribute accesses that return trace-time Python values even on tracers
 STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "capacity"})
@@ -110,6 +114,11 @@ class _Tainter:
 
     def taint(self, expr) -> bool:
         if expr is None or isinstance(expr, ast.Constant):
+            return False
+        if isinstance(expr, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            # identity checks (`x is None`) are trace-time Python facts —
+            # pytree structure, not array values (a tracer is never None)
             return False
         if isinstance(expr, ast.Name):
             return self.env.get(expr.id, False)

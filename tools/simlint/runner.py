@@ -5,7 +5,9 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
-from tools.simlint import compactstore, determinism, findings as F, lockset, purity
+from tools.simlint import (
+    compactstore, determinism, findings as F, lockset, policykernel, purity,
+)
 from tools.simlint.callgraph import CallGraph
 from tools.simlint.project import Module, in_scope, load_target
 
@@ -25,9 +27,14 @@ DET_RULES = ("det-unordered-iter", "det-wallclock", "det-chunk-sync")
 # compact-storage discipline shares the purity scope: the SoA layouts and
 # every code path that can store into them live in the jitted tick closure
 COMPACT_RULES = ("compact-store",)
+# the policy zoo's kernels (policies/kernels.py): the purity node checks
+# applied to EVERY function — table-dispatched kernels escape jit-entry
+# reachability — plus the params-are-traced-data obligation (ISSUE 6)
+POLICY_KERNEL_FILES = ("policies/kernels.py",)
+POLICY_KERNEL_RULES = ("policy-kernel",)
 PRAGMA_RULES = ("pragma-no-reason", "pragma-stale")
 ALL_RULES = (PURITY_RULES + LOCKSET_RULES + DET_RULES + COMPACT_RULES
-             + PRAGMA_RULES)
+             + POLICY_KERNEL_RULES + PRAGMA_RULES)
 
 
 def run(target: str, rules: Optional[Iterable[str]] = None,
@@ -56,6 +63,10 @@ def run(target: str, rules: Optional[Iterable[str]] = None,
         if in_scope(mod, DET_DIRS):
             raw += determinism.check_module(mod)
             checked.update(DET_RULES)
+        if in_scope(mod, (), POLICY_KERNEL_FILES) and (
+                mod.relpath != "" or policykernel.module_takes_params(mod)):
+            raw += policykernel.check_module(mod)
+            checked.update(POLICY_KERNEL_RULES)
 
     if selected is not None:
         raw = [f for f in raw if f.rule in selected]

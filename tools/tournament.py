@@ -1,0 +1,356 @@
+#!/usr/bin/env python
+"""Policy-tournament driver: one compiled program, the whole scheduler zoo.
+
+The generalization of tools/market_ab.py the policy-as-data refactor buys
+(ARCHITECTURE.md §policy zoo): instead of one trace + one compile + one
+run per policy variant, the engine compiles ONE program over a
+``PolicySet`` and the driver sweeps the (policy, seed) grid as DATA — the
+seed axis is ``vmap``-ed (all replications resident on device), the policy
+axis is a ``PolicyParams`` row per variant fed to the same jitted function
+(zero recompiles: the traced ``params.idx`` switch runs only the selected
+kernel per call). Compile count is therefore independent of sweep size —
+the driver asserts the jit cache holds exactly one entry after the whole
+grid — and every cell is bit-identical to its standalone single-policy
+run, which the driver re-runs as both the correctness oracle and the
+serial-baseline wall clock the recorded speedup is measured against.
+
+Trace-parallel mode (ROADMAP item 3b): with more than one device and a
+divisible seed axis, the replication axis is sharded over the device mesh
+(cells are embarrassingly parallel — sharding is bitwise invisible, the
+equality gate proves it on every run).
+
+Run: ``python tools/tournament.py [--quick]`` or ``python bench.py
+--tournament``. Writes a markdown table to stdout and JSON to
+tools/tournament.json (bench.py embeds the same detail dict).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# the default lineup: the reference repertoire, its parameter variants
+# (free under policy-as-data — same compiled kernel, different leaves), and
+# the heterogeneity/packing zoo members
+DEFAULT_POLICIES = ("fifo", "delay", "delay-eager", "delay-patient",
+                    "ffd", "ffd-memfirst", "gavel", "tesserae")
+
+
+def sweep_policies():
+    """Register and return the parameter-sweep lineup (48 variants — 16
+    points each over the delay/gavel/tesserae kinds): a DELAY
+    promotion-threshold grid (binds
+    under the saturated tournament load — thresholds change promotion
+    ticks, hence Level1 order, hence placements), a Gavel grid whose
+    core-heavy-class throughput on accelerator nodes crosses the
+    preference-flipping point 1.0 (sc < 1 avoids the accel nodes, sc > 1
+    routes onto them), and a Tesserae mem-weight grid spanning four
+    decades of the demand·free trade-off. This is the shape the refactor
+    exists for — every variant here is pure parameter DATA (zero extra
+    compiles in the tournament), while the serial loop pays one trace +
+    one compile per variant."""
+    from multi_cluster_simulator_tpu.policies import REGISTRY, variant
+
+    names = []
+    for w in (1_000, 2_000, 3_000, 4_000, 6_000, 8_000, 10_000, 12_000,
+              14_000, 16_000, 20_000, 24_000, 28_000, 32_000, 36_000,
+              40_000):
+        n = f"delay-w{w}"
+        if n not in REGISTRY:
+            variant(n, "delay", max_wait_ms=w)
+        names.append(n)
+    for i, sc in enumerate((0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0, 1.1, 1.25,
+                            1.5, 2.0, 2.5, 3.0, 4.0, 6.0, 8.0)):
+        n = f"gavel-accel{i}"
+        if n not in REGISTRY:
+            variant(n, "gavel", gavel_tput=(
+                (1.0, 1.0, 1.0, 1.0), (1.0, sc, 1.0, 1.0),
+                (0.5, sc, 1.0, 1.0), (0.5, sc, 1.0, 1.0)))
+        names.append(n)
+    for i, mw in enumerate((1e-4, 2e-4, 3e-4, 5e-4, 1e-3, 2e-3, 3e-3, 5e-3,
+                            1e-2, 2e-2, 3e-2, 5e-2, 0.1, 0.3, 1.0, 3.0)):
+        n = f"tess-mem{i}"
+        if n not in REGISTRY:
+            variant(n, "tesserae", tess_w=(1.0, mw, 1.0))
+        names.append(n)
+    return tuple(names)
+
+
+def _specs(C):
+    """Heterogeneous clusters for the device-type-aware members: five
+    uniform nodes, the last two typed as accelerators (device_type 1) —
+    same capacities, so type-blind policies are unaffected."""
+    from multi_cluster_simulator_tpu.core.spec import ClusterSpec, NodeSpec
+
+    def cluster(cid):
+        return ClusterSpec(id=cid, nodes=tuple(
+            NodeSpec(id=i + 1, cores=32, memory=24_000,
+                     device_type=1 if i >= 3 else 0) for i in range(5)))
+
+    return [cluster(c + 1) for c in range(C)]
+
+
+def _cfg(queue_capacity=96, max_running=96, jobs_per=120):
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+
+    # One config every zoo member can run: parity semantics (the bounded
+    # while-loop sweeps make them cheap), no borrowing/trader (policy-axis
+    # A/B, not market A/B), bounds sized so no cell drops — the zero-drops
+    # gate below keeps cells comparable across policies.
+    return SimConfig(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                     queue_capacity=queue_capacity, max_running=max_running,
+                     max_arrivals=jobs_per, max_ingest_per_tick=32,
+                     max_nodes=5, max_virtual_nodes=0)
+
+
+def _pack_seeds(arrs, n_ticks, tick_ms):
+    """Pack each seed's stream once (pack_arrivals_by_tick), pad every
+    bucket to the grid-global K, and stack on a leading seed axis — the
+    'arrivals packed once and broadcast' half of the tournament contract.
+    Padding rows are invalid sentinels the ingest masks off (the same
+    invariant the ragged chunk pipeline relies on), so the shared K is
+    invisible to every cell. Returns (stacked TickArrivals, per-seed
+    unpadded buckets for the standalone oracle runs)."""
+    import jax.numpy as jnp
+
+    from multi_cluster_simulator_tpu.core.engine import pack_arrivals_by_tick
+    from multi_cluster_simulator_tpu.core.state import TickArrivals
+    from multi_cluster_simulator_tpu.ops import queues as Q
+
+    tas = [pack_arrivals_by_tick(a, n_ticks, tick_ms) for a in arrs]
+    K = max(ta.rows.shape[2] for ta in tas)
+    rows = []
+    for ta in tas:
+        r = np.asarray(ta.rows)
+        if r.shape[2] < K:
+            pad = np.broadcast_to(
+                np.asarray(Q._INVALID_ROW),
+                r.shape[:2] + (K - r.shape[2], Q.NF))
+            r = np.concatenate([r, pad], axis=2)
+        rows.append(r)
+    stacked = TickArrivals(rows=jnp.asarray(np.stack(rows)),
+                           counts=jnp.asarray(np.stack(
+                               [np.asarray(ta.counts) for ta in tas])))
+    return stacked, tas
+
+
+def _cell_stats(state, C, jobs_per):
+    from multi_cluster_simulator_tpu.core.state import avg_wait_ms
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+
+    placed = int(np.asarray(state.placed_total).sum())
+    waits = np.asarray(avg_wait_ms(state))
+    return {"placed": placed, "of": C * jobs_per,
+            "placed_frac": round(placed / max(C * jobs_per, 1), 4),
+            "mean_avg_wait_ms": round(float(waits.mean()), 1),
+            "drops": total_drops(state)}
+
+
+def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
+                   horizon_ms=240_000, drain_ticks=80, verify_cells=True,
+                   shard_seeds="auto"):
+    """Run the (policy, seed) grid; returns the tournament detail dict.
+
+    Gates (raise on violation — CI runs this via bench.py --tournament):
+    - the grid function compiles exactly once for the whole sweep;
+    - every cell's final state is bit-identical to its standalone
+      single-policy run (``verify_cells``);
+    - no cell drops work (bounds sized for the lineup).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.policies import PolicySet, params_digest
+
+    policies = tuple(policies)
+    pset = PolicySet(policies)
+    cfg = _cfg(jobs_per=jobs_per)
+    specs = _specs(C)
+    n_ticks = horizon_ms // cfg.tick_ms + drain_ticks  # drain tail
+    seeds = list(range(17, 17 + n_seeds))
+
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+    # demands up to 24 cores on 32-core nodes: both demand-shape classes
+    # exist (job_class splits at cores > 8) and the grid runs loaded, so
+    # promotion thresholds, throughput matrices, and packing weights all
+    # actually steer placements — a policy sweep over an idle grid ranks
+    # noise. Per-cluster arrivals never exceed queue_capacity, so the
+    # zero-drops gate holds by sizing.
+    arrs = [uniform_stream(C, jobs_per, horizon_ms, max_cores=24,
+                           max_mem=18_000, max_dur_ms=30_000, seed=s)
+            for s in seeds]
+    t_pack0 = time.time()
+    stacked, tas = _pack_seeds(arrs, n_ticks, cfg.tick_ms)
+    pack_s = time.time() - t_pack0
+
+    state0 = init_state(cfg, specs)
+    eng = Engine(cfg, policies=pset)
+
+    def grid_fn(state, ta, params):
+        # seed axis vmapped (state + params broadcast); the policy axis is
+        # a params row per call of this SAME jitted function — lax.switch
+        # on the scalar traced idx runs only the selected kernel
+        return jax.vmap(lambda a: eng.run(state, a, n_ticks, params))(ta)
+
+    fn = jax.jit(grid_fn)
+
+    # trace-parallel mode: shard the replication (seed) axis over devices
+    n_dev = len(jax.devices())
+    sharded = (shard_seeds == "always"
+               or (shard_seeds == "auto" and n_dev > 1)) \
+        and n_seeds % max(n_dev, 1) == 0 and n_dev > 1
+    if sharded:
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()), ("replications",))
+        stacked = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(mesh,
+                                                      P("replications"))),
+            stacked)
+
+    variant_params = [pset.params_for(cfg, name) for name in policies]
+    t0 = time.time()
+    grid = [jax.block_until_ready(fn(state0, stacked, p))
+            for p in variant_params]
+    tournament_wall = time.time() - t0
+    cache_size = getattr(fn, "_cache_size", lambda: None)()
+    if cache_size is None:
+        # fail loudly rather than fabricate a passing gate: a jax that
+        # renames the cache probe would otherwise let a recompile-per-
+        # variant regression ship with compiled_programs silently "1"
+        raise AssertionError(
+            "jit cache probe unavailable (jax renamed _cache_size?) — "
+            "update the compile-count gate in tools/tournament.py")
+    if cache_size != 1:
+        raise AssertionError(
+            f"tournament compiled {cache_size} programs for "
+            f"{len(policies)}x{n_seeds} cells — compile count must be "
+            "independent of sweep size (exactly one)")
+
+    # serial per-policy loop: the pre-zoo workflow (one Engine, one trace,
+    # one compile per variant — the market_ab shape) — both the recorded
+    # baseline wall AND the bit-equality oracle for every cell. Skipped
+    # entirely under verify_cells=False: the loop exists only for the
+    # comparison, so --no-verify also skips the baseline wall.
+    serial_wall = None
+    rows = []
+    mismatches = []
+    if verify_cells:
+        # the baseline wall times ONLY the engine-build + trace/compile +
+        # runs (what the pre-zoo workflow actually paid per variant) —
+        # the equality comparison below is verification overhead and is
+        # timed out of the baseline
+        serial_wall = 0.0
+        for v, name in enumerate(policies):
+            t0 = time.time()
+            eng1 = Engine(cfg, policies=PolicySet((name,)))
+            fn1 = eng1.run_jit()
+            refs = [jax.block_until_ready(fn1(state0, tas[si], n_ticks))
+                    for si in range(n_seeds)]
+            serial_wall += time.time() - t0
+            for si, ref in enumerate(refs):
+                cell = jax.tree.map(lambda a, i=si: a[i], grid[v])
+                for la, lb in zip(jax.tree.leaves(cell),
+                                  jax.tree.leaves(ref)):
+                    if not np.array_equal(np.asarray(la), np.asarray(lb)):
+                        mismatches.append((name, seeds[si]))
+                        break
+    if mismatches:
+        raise AssertionError(
+            "tournament cells diverge from their standalone runs: "
+            f"{sorted(set(mismatches))}")
+
+    for v, name in enumerate(policies):
+        digest = params_digest(variant_params[v])
+        for si, s in enumerate(seeds):
+            cell = jax.tree.map(lambda a, i=si: a[i], grid[v])
+            stats = _cell_stats(cell, C, jobs_per)
+            if any(stats["drops"].values()):
+                raise AssertionError(
+                    f"tournament cell ({name}, seed {s}) dropped work "
+                    f"({stats['drops']}) — resize the tournament config")
+            rows.append({"policy": name, "params_digest": digest,
+                         "seed": s, **stats})
+
+    # rank: most work placed, then lowest mean wait, aggregated over seeds
+    agg = {}
+    for r in rows:
+        a = agg.setdefault(r["policy"], {"policy": r["policy"],
+                                         "params_digest": r["params_digest"],
+                                         "placed": 0, "waits": []})
+        a["placed"] += r["placed"]
+        a["waits"].append(r["mean_avg_wait_ms"])
+    ranking = sorted(agg.values(),
+                     key=lambda a: (-a["placed"], float(np.mean(a["waits"]))))
+    for i, a in enumerate(ranking):
+        a["rank"] = i + 1
+        a["mean_avg_wait_ms"] = round(float(np.mean(a.pop("waits"))), 1)
+
+    detail = {
+        "policies": list(policies), "seeds": seeds, "clusters": C,
+        "jobs_per_cluster": jobs_per, "cells": len(policies) * n_seeds,
+        "ticks": n_ticks,
+        "backend": jax.default_backend(), "devices": n_dev,
+        "replication_axis_sharded": bool(sharded),
+        "compiled_programs": cache_size,
+        "pack_once_s": round(pack_s, 3),
+        "tournament_wall_s": round(tournament_wall, 3),
+        "cells_bit_identical_to_standalone": bool(verify_cells),
+        "ranking": ranking,
+        "rows": rows,
+    }
+    if serial_wall is not None:
+        detail["serial_loop_wall_s"] = round(serial_wall, 3)
+        detail["speedup_vs_serial"] = round(
+            serial_wall / max(tournament_wall, 1e-9), 2)
+    return detail
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--policies", nargs="+", default=list(DEFAULT_POLICIES))
+    ap.add_argument("--seeds", type=int, default=4)
+    ap.add_argument("--clusters", type=int, default=64)
+    ap.add_argument("--jobs", type=int, default=120)
+    ap.add_argument("--horizon-ms", type=int, default=240_000)
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke shape (4 policies x 2 seeds, small grid)")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip the per-cell standalone equality check "
+                         "(also skips the serial baseline wall)")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tournament.json"))
+    args = ap.parse_args(argv)
+    kw = dict(policies=tuple(args.policies), n_seeds=args.seeds,
+              C=args.clusters, jobs_per=args.jobs,
+              horizon_ms=args.horizon_ms,
+              verify_cells=not args.no_verify)
+    if args.quick:
+        kw.update(policies=tuple(args.policies[:4]) if len(args.policies) > 4
+                  else tuple(args.policies),
+                  n_seeds=2, C=16, jobs_per=60, horizon_ms=120_000)
+    detail = run_tournament(**kw)
+    with open(args.out, "w") as f:
+        json.dump(detail, f, indent=2)
+    speed = detail.get("speedup_vs_serial", "n/a (--no-verify)")
+    print(f"# {detail['cells']} cells, {detail['compiled_programs']} "
+          f"compile(s), {speed}x vs serial loop", file=sys.stderr)
+    print("| rank | policy | params | placed | mean avg wait (ms) |")
+    print("|---|---|---|---|---|")
+    for a in detail["ranking"]:
+        print(f"| {a['rank']} | {a['policy']} | {a['params_digest']} | "
+              f"{a['placed']} | {a['mean_avg_wait_ms']} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
