@@ -18,7 +18,8 @@ Usage:
   python bench.py                 # headline (north star)
   python bench.py --config NAME   # fifo_small | fifo_two_trader | ffd64 |
                                   # sinkhorn | borg4k | sparse_bursts |
-                                  # scale16k | headline
+                                  # scale16k | headline | tournament | env
+  python bench.py --env-bench     # batched RL-environment stepping (envs/)
   python bench.py --all           # every config; details to bench_results.json
 """
 
@@ -1364,6 +1365,184 @@ def bench_tournament(quick=False):
     }
 
 
+def bench_env(quick=False):
+    """Environment mode (envs/, ARCHITECTURE.md §environment mode): B env
+    instances — each a full constellation — resident on device, stepping
+    through ONE compiled vmapped program with per-env PRNG streams,
+    on-device arrival generation, the rl action port at the placement
+    phase, and auto-reset compiled into the step. Reported value:
+    envs·steps per wall second.
+
+    Gates (raise on violation — CI runs the quick shape):
+    - the batched step compiles exactly once for the whole run (auto-reset
+      included: episode boundaries cause no retrace and no host sync);
+    - zero explicit host->device transfers inside the step loop (counted
+      by instrumenting jax.device_put for the duration of the timed loop —
+      EnvState is donated and updates in place in HBM);
+    - auto-reset actually engages (total steps span multiple episodes and
+      every env's episode counter shows it);
+    - no env drops work (bounds sized for the generative stream);
+    - a batch=1 replay-mode cell is bit-identical to the standalone
+      ``Engine.run_jit`` over the same bucketed arrivals (the oracle pin,
+      also tier-1: tests/test_env.py);
+    - the batched program beats a serial loop over single-env steps (the
+      host-stepped-gym shape Decima/Blox pay) — the measured speedup is
+      the recorded headline.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    from multi_cluster_simulator_tpu.core.engine import (
+        Engine, pack_arrivals_by_tick,
+    )
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.envs import ClusterEnv, StreamGen
+    from multi_cluster_simulator_tpu.policies import PolicySet
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+    B = 64 if quick else 1024  # env instances resident on device
+    C = 4 if quick else 8  # clusters per env
+    T_ep = 20 if quick else 50  # episode length (ticks)
+    steps = 50 if quick else 125  # total steps (> 2 episodes: auto-reset)
+    n_serial = 16  # serial-loop sample (per-env-step rates compare 1:1)
+    gen = StreamGen(rate=2.0, k_max=8, max_cores=8, max_mem=6_000,
+                    max_dur_ms=15_000)
+    cfg = SimConfig(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                    queue_capacity=16, max_running=64, max_arrivals=8,
+                    max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0)
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    env = ClusterEnv(cfg, specs, episode_ticks=T_ep, gen=gen,
+                     policies=PolicySet(("rl",)), reward="neg_mean_wait")
+    action = jnp.zeros((B,) + env.action_shape, jnp.float32)
+    obs0, es0 = env.reset_batch(jax.random.PRNGKey(17), B)
+    step = env.batch_step_fn(donate=True)
+
+    def run_batched(es):
+        for _ in range(steps):
+            obs, r, d, info, es = step(es, action)
+        jax.block_until_ready(es)
+        return es
+
+    # compile + warmup run, then timed repeats with device_put instrumented:
+    # zero explicit transfers may enter the step loop (the donated EnvState
+    # never leaves HBM; the action/reset-state/replay buffers are resident)
+    es_fin = run_batched(jax.tree.map(jnp.copy, es0))
+    walls = []
+    put_calls = {"n": 0}
+    orig_put = jax.device_put
+
+    def counting_put(*a, **kw):
+        put_calls["n"] += 1
+        return orig_put(*a, **kw)
+
+    jax.device_put = counting_put
+    try:
+        for _ in range(2 if quick else 3):
+            # step donates es: re-clone es0 per repeat OUTSIDE the timer —
+            # the clone is harness bookkeeping, not stepping cost
+            es_in = jax.block_until_ready(jax.tree.map(jnp.copy, es0))
+            t0 = time.time()
+            es_fin = run_batched(es_in)
+            np.asarray(es_fin.sim.t)  # force a host read inside the timer
+            walls.append(time.time() - t0)
+    finally:
+        jax.device_put = orig_put
+    assert put_calls["n"] == 0, (
+        f"env step loop issued {put_calls['n']} device_put calls — stepping "
+        "must be zero-transfer (donated EnvState, resident buffers)")
+    cache = getattr(step._jit, "_cache_size", lambda: None)()
+    if cache is None:
+        # fail loudly rather than fabricate a passing gate (same contract
+        # as tools/tournament.py's compile-count probe)
+        raise AssertionError(
+            "jit cache probe unavailable (jax renamed _cache_size?) — "
+            "update the compile-count gate in bench_env")
+    assert cache == 1, (
+        f"batched env step compiled {cache} programs over {steps} steps — "
+        "auto-reset must not retrace")
+    episodes = np.asarray(es_fin.episodes)
+    want_eps = steps // T_ep
+    assert want_eps >= 2 and (episodes == want_eps).all(), (
+        f"auto-reset never engaged: episode counters {episodes.min()}.."
+        f"{episodes.max()}, expected {want_eps} everywhere")
+    drops = total_drops(es_fin.sim)
+    assert all(v == 0 for v in drops.values()), (
+        f"env bench dropped work ({drops}) — resize the env config")
+    wall = min(walls)
+    rate = B * steps / max(wall, 1e-9)
+
+    # serial baseline: the SAME per-env work, one env instance per step
+    # call — the host-stepped-gym dispatch pattern. envs·steps/sec is a
+    # per-env-step rate, so a smaller serial sample compares 1:1.
+    sstep = env.step_fn(donate=False)
+    skeys = jax.random.split(jax.random.PRNGKey(23), n_serial)
+    serial_states = [env.reset(k)[1] for k in skeys]
+    a1 = jnp.zeros(env.action_shape, jnp.float32)
+    for es in serial_states[:1]:  # compile once outside the timer
+        sstep(es, a1)
+    t0 = time.time()
+    for es in serial_states:
+        for _ in range(steps):
+            _, _, _, _, es = sstep(es, a1)
+        # simlint: ignore[det-chunk-sync] -- this loop IS the measured
+        # baseline: the host-stepped-gym dispatch pattern, synced per env
+        # trajectory exactly like a per-transition training loop would be
+        np.asarray(es.sim.t)
+    serial_wall = time.time() - t0
+    serial_rate = n_serial * steps / max(serial_wall, 1e-9)
+    speedup = rate / max(serial_rate, 1e-9)
+    assert speedup > 1.0, (
+        f"batched env stepping ({rate:.0f} env-steps/s) does not beat the "
+        f"serial single-env loop ({serial_rate:.0f})")
+
+    # oracle pin on the artifact itself: a batch=1 replay cell is
+    # bit-identical to the standalone Engine.run_jit over the same bucket
+    T_pin = 30
+    arr = uniform_stream(C, 40, T_pin * 1_000, max_cores=8, max_mem=6_000,
+                         max_dur_ms=15_000, seed=5)
+    ta = pack_arrivals_by_tick(arr, T_pin + 1, cfg.tick_ms)
+    env1 = ClusterEnv(cfg, specs, episode_ticks=T_pin + 1, arrivals=ta)
+    _, es1 = env1.reset(jax.random.PRNGKey(0))
+    pin_step = env1.step_fn()
+    for _ in range(T_pin):
+        _, _, _, _, es1 = pin_step(es1, None)
+    ref = Engine(cfg).run_jit()(
+        init_state(cfg, specs),
+        jax.tree.map(lambda x: x[:T_pin], ta), T_pin)
+    for la, lb in zip(jax.tree.leaves(es1.sim), jax.tree.leaves(ref)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+            "env batch=1 replay cell diverges from Engine.run_jit")
+
+    detail = {
+        "envs": B, "clusters_per_env": C, "episode_ticks": T_ep,
+        "steps": steps, "envs_steps_per_sec": round(rate, 1),
+        "walls": [round(w, 3) for w in walls], "timing": f"min-of-{len(walls)}",
+        "auto_resets_per_env": int(want_eps),
+        "serial_envs": n_serial,
+        "serial_envs_steps_per_sec": round(serial_rate, 1),
+        "speedup_vs_serial_loop": round(speedup, 2),
+        "compiled_programs": cache,
+        "device_put_calls_in_step_loop": put_calls["n"],
+        "batch1_bit_identical_to_run_jit": True,
+        "drops": drops,
+        "arrival_mode": f"on-device generative (rate={gen.rate}/tick/cluster)",
+        # provenance: joinable with tournament/bench rows (PR 6 contract) +
+        # the reward variant the reward weights encode
+        **env.provenance(),
+        "backend": jax.default_backend(), "devices": len(jax.devices()),
+    }
+    return {
+        "metric": "env_mode_envs_steps_per_sec",
+        "value": round(rate, 1),
+        "unit": "env-steps/s",
+        "vs_baseline": round(speedup, 2),
+        "detail": detail,
+    }
+
+
 CONFIGS = {
     "headline": bench_headline,
     "parity_tpu": bench_parity_tpu,
@@ -1377,6 +1556,7 @@ CONFIGS = {
     "sparse_bursts": bench_sparse_bursts,
     "live": bench_live,
     "tournament": bench_tournament,
+    "env": bench_env,
 }
 
 
@@ -1411,6 +1591,10 @@ def main():
                     help="shorthand for --config tournament: one compiled "
                          "policy-tournament over the scheduler zoo "
                          "(tools/tournament.py)")
+    ap.add_argument("--env-bench", action="store_true",
+                    help="shorthand for --config env: batched RL-environment "
+                         "stepping (envs/) — envs·steps/sec with auto-reset, "
+                         "per-env PRNG streams, and the serial-loop A/B")
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true",
                     help="shrunk shapes for smoke-testing the harness")
@@ -1455,6 +1639,8 @@ def main():
     args = ap.parse_args()
     if args.tournament:
         args.config = "tournament"
+    if args.env_bench:
+        args.config = "env"
     _setup_jax(args.compile_cache_dir, not args.no_compile_cache)
     _CKPT["path"] = args.checkpoint
     _CKPT["resume"] = args.resume
@@ -1510,14 +1696,14 @@ def main():
 
         _PIPELINE["mode"] = "on" if args.pipeline == "ab" else args.pipeline
         res = call()
-        if args.pipeline == "ab" and name not in ("parity_tpu", "live", "tournament"):
+        if args.pipeline == "ab" and name not in ("parity_tpu", "live", "tournament", "env"):
             ab_compare(res, _PIPELINE, "on", "pipeline_ab",
                        "pipelined", "unpipelined")
-        if args.time_compress == "ab" and name not in ("parity_tpu", "live", "tournament"):
+        if args.time_compress == "ab" and name not in ("parity_tpu", "live", "tournament", "env"):
             ab_compare(res, _TIME_COMPRESS, "auto", "time_compress_ab",
                        "compressed", "dense",
                        extra=("ticks_executed", "ticks_simulated"))
-        if args.compact == "ab" and name not in ("parity_tpu", "live", "tournament"):
+        if args.compact == "ab" and name not in ("parity_tpu", "live", "tournament", "env"):
 
             def compact_gates(d, doff, ab):
                 # correctness gate, not just walls: the wide re-run must

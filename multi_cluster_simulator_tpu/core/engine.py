@@ -634,7 +634,7 @@ class Engine:
         provenance key every bench/probe row records so results stay
         joinable across BENCH_*.json rounds. With the default params this
         names the singleton policy; a multi-member engine lists the set."""
-        if len(self.pset.names) == 1:
+        if params is None and len(self.pset.names) == 1:
             return self.pset.provenance(self.cfg)
         from multi_cluster_simulator_tpu.policies.base import params_digest
         p = params if params is not None else self._default_params
@@ -648,6 +648,19 @@ class Engine:
     def tick_io(self, state: SimState, arrivals: Arrivals) -> tuple[SimState, TickIO]:
         """One tick, also returning the host-visible TickIO events."""
         return self._tick(state, pack_arrivals(arrivals), emit_io=True)
+
+    def step_tick(self, state: SimState, rows: jax.Array, counts: jax.Array,
+                  params=None) -> SimState:
+        """One tick with pre-bucketed per-tick arrivals — the environment
+        mode's step entry (envs/cluster_env.py). ``rows [C, K, NF]`` /
+        ``counts [C]`` are exactly one tick's TickArrivals slice, so this
+        is the scan body of the tick-indexed ``run`` called once: the env's
+        T-step trajectory is bit-identical to one ``run_jit`` call over the
+        same bucketed stream (tests/test_env.py pins it). ``params`` is
+        the PolicyParams pytree — the RL action enters here as the
+        ``rl_scores`` leaf."""
+        return self._tick(state, (rows, counts), emit_io=False,
+                          tick_indexed=True, params=params)[0]
 
     def _tick(self, state: SimState, packed_arrivals, emit_io: bool,
               tick_indexed: bool = False, params=None):

@@ -1,0 +1,15 @@
+"""envs/ — the simulator as an on-device batched gym (ARCHITECTURE.md
+§environment mode): vmapped env instances over the engine's tick with
+per-env PRNG streams, compiled auto-reset, the rl policy kind as the
+action port, and pluggable reward weights as data."""
+
+from multi_cluster_simulator_tpu.envs.cluster_env import (
+    REWARD_VARIANTS, ClusterEnv, EnvInfo, EnvState, StreamGen,
+    shard_env_batch,
+)
+from multi_cluster_simulator_tpu.envs.obs import n_obs_features, observe
+
+__all__ = [
+    "REWARD_VARIANTS", "ClusterEnv", "EnvInfo", "EnvState", "StreamGen",
+    "shard_env_batch", "n_obs_features", "observe",
+]

@@ -1,0 +1,299 @@
+"""The simulator as an on-device batched gym (ROADMAP item 2).
+
+Decima (arxiv 1810.01963) and Blox (arxiv 2312.12621) train schedulers
+against cluster simulators stepped ON THE HOST, one transition at a time.
+Here the whole environment is the pure-JAX tick: ``ClusterEnv.step`` is the
+engine's 7-phase tick body (``Engine.step_tick`` — the same code
+``run_jit`` scans, bit-identical by construction) wrapped with observation,
+reward, and auto-reset, and the batch axis is ``vmap`` over env instances —
+thousands of constellations resident in device memory, stepping in one
+compiled program with zero host round-trips:
+
+- **per-env PRNG streams**: every ``EnvState`` carries its own key;
+  ``step`` splits it (``jax.random.split``) and the generative workload
+  draws each tick's arrivals from the split — never a key shared across
+  the batch axis (simlint rule ``env-rng`` enforces the discipline).
+- **auto-reset inside the compiled step**: ``done`` selects every state
+  leaf back to the cached reset constellation (a ``jnp.where`` — i.e.
+  ``lax.select`` — per leaf), so a 4k-env batch never syncs to the host to
+  restart finished episodes.
+- **actions are policy parameters**: the action enters the placement phase
+  as the ``rl`` policy kind's ``rl_scores`` leaf (policies/), a
+  [N_JOB_CLASSES, N_DEVICE_TYPES] score matrix feeding the same
+  ``_scored_sweep_local`` accounting as the Gavel/Tesserae zoo members —
+  scoring reused, not duplicated. Under the env vmap the leaf is per-env:
+  exactly "a policy whose params are network outputs".
+- **reward is data**: ``EnvState.reward_w`` weighs (negative mean wait,
+  throughput, drop penalty); switching variants is a leaf write, not a
+  recompile (REWARD_VARIANTS names the built-ins).
+- **two workload modes**: ``arrivals=`` replays a host-bucketed
+  ``TickArrivals`` episode shared by every env (batching is invisible to
+  replay — PARITY.md), which is how the batch=1 cell is pinned
+  bit-identical to ``Engine.run_jit``; ``gen=`` draws arrivals on device
+  per tick from the env's key (workload/traces.tick_arrivals_device), the
+  fully device-resident training regime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.core import state as st
+from multi_cluster_simulator_tpu.core.engine import Engine
+from multi_cluster_simulator_tpu.core.state import SimState, TickArrivals, init_state
+from multi_cluster_simulator_tpu.envs.obs import n_obs_features, observe
+from multi_cluster_simulator_tpu.ops import fields as F
+from multi_cluster_simulator_tpu.workload.traces import tick_arrivals_device
+
+# reward variants as data: (wait, throughput, drop) weights for
+# EnvState.reward_w. wait is negated mean avg-wait in SECONDS, throughput
+# is jobs placed this step, drop the summed drop-counter delta.
+REWARD_VARIANTS = {
+    "neg_mean_wait": (1.0, 0.0, 0.0),
+    "throughput": (0.0, 1.0, 0.0),
+    "drop_penalty": (1.0, 0.0, 10.0),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamGen:
+    """Generative-mode workload parameters (static: they size the per-tick
+    candidate tensor). ``rate`` is expected jobs per cluster per tick;
+    ``k_max`` the static per-(tick, cluster) fanout bound — the analogue of
+    the bucketed path's K."""
+
+    rate: float = 2.0
+    k_max: int = 8
+    max_cores: int = 8
+    max_mem: int = 6_000
+    max_dur_ms: int = 20_000
+    beta: float = 2.0
+
+
+@struct.dataclass
+class EnvState:
+    """One env instance's carried state. All leaves are per-env (the batch
+    axis is the leading vmap axis); ``key`` is this env's OWN stream —
+    ``step`` splits it, auto-reset keeps splitting it, and no key is ever
+    shared across the batch (env-rng)."""
+
+    sim: SimState
+    key: jax.Array  # per-env PRNG stream
+    t_ep: jax.Array  # [] i32 — tick index within the current episode
+    episodes: jax.Array  # [] i32 — completed (auto-reset) episodes
+    reward_w: jax.Array  # [3] f32 — (wait, throughput, drop) weights
+
+
+@struct.dataclass
+class EnvInfo:
+    """Per-step diagnostics (device values; coerce outside the step loop)."""
+
+    placed: jax.Array  # [] i32 — jobs placed this step
+    dropped: jax.Array  # [] i32 — drop-counter delta this step
+    episodes: jax.Array  # [] i32 — completed episodes after this step
+    t: jax.Array  # [] i32 — sim clock after the tick (pre-reset)
+
+
+def _drop_sum(s: SimState) -> jax.Array:
+    """In-graph total of every drop counter (plus the compact layouts'
+    narrow-store overflow counters) — the traced form of
+    utils/trace.total_drops, for the drop-penalty reward."""
+    d = s.drops
+    total = (jnp.sum(d.queue) + jnp.sum(d.msgs) + jnp.sum(d.run_full)
+             + jnp.sum(d.vslot) + jnp.sum(d.carve) + jnp.sum(d.ingest))
+    for part in (s.l0, s.l1, s.ready, s.wait, s.lent, s.borrowed, s.run):
+        if hasattr(part, "ovf"):
+            total = total + jnp.sum(part.ovf)
+    return total.astype(jnp.int32)
+
+
+class ClusterEnv:
+    """Batched ``reset(key) -> (obs, EnvState)`` /
+    ``step(EnvState, action) -> (obs, reward, done, info, EnvState)`` over
+    the simulation engine.
+
+    ``policies`` defaults to the config's singleton set; pass
+    ``PolicySet(("rl",))`` for the learned-scheduler action port (any other
+    set ignores the action and runs its own policy — how the fifo oracle
+    pin steps the env). Exactly one of ``arrivals`` (a host-bucketed
+    TickArrivals covering >= episode_ticks, replayed identically by every
+    env and every episode) or ``gen`` (a StreamGen drawn per tick from the
+    env key) selects the workload mode. ``plan`` builds the compact SoA
+    state layout (core/compact.py) — the env is layout-blind like the
+    engine."""
+
+    def __init__(self, cfg: SimConfig, specs, episode_ticks: int,
+                 arrivals: TickArrivals | None = None,
+                 gen: StreamGen | None = None, policies=None,
+                 reward="neg_mean_wait", plan=None):
+        if (arrivals is None) == (gen is None):
+            raise ValueError("pass exactly one of arrivals= (replay) or "
+                             "gen= (on-device generation)")
+        if gen is not None and cfg.borrowing:
+            raise ValueError(
+                "generative mode emits tick-local job ids, and the "
+                "borrowing return path matches borrowed rows on (id, "
+                "cores, mem, dur) — gen= requires cfg.borrowing=False "
+                "(replay a globally-id'd TickArrivals stream instead)")
+        self.cfg = cfg
+        self.specs = list(specs)
+        self.engine = Engine(cfg, policies=policies)
+        self.pset = self.engine.pset
+        self.episode_ticks = int(episode_ticks)
+        if self.episode_ticks < 1:
+            raise ValueError("episode_ticks must be >= 1")
+        if arrivals is not None and arrivals.rows.shape[0] < self.episode_ticks:
+            raise ValueError(
+                f"replay TickArrivals covers {arrivals.rows.shape[0]} ticks, "
+                f"episode needs {self.episode_ticks}")
+        self.gen = gen
+        # commit replay rows to the device ONCE: numpy leaves passed to jit
+        # re-transfer per call, which would be a per-step H2D
+        self._arr = None if arrivals is None else jax.device_put(arrivals)
+        self._params = self.pset.params_for(cfg)
+        w = REWARD_VARIANTS[reward] if isinstance(reward, str) else reward
+        self.reward_name = reward if isinstance(reward, str) else "custom"
+        self._reward_w = jnp.asarray(np.asarray(w, np.float32))
+        if self._reward_w.shape != (3,):
+            raise ValueError("reward weights must be 3 floats "
+                             "(wait, throughput, drop)")
+        self._sim0 = init_state(cfg, specs, plan=plan)
+
+    # -- geometry ----------------------------------------------------------
+
+    @property
+    def n_clusters(self) -> int:
+        return len(self.specs)
+
+    @property
+    def n_obs(self) -> int:
+        return n_obs_features(self.cfg)
+
+    @property
+    def action_shape(self) -> tuple:
+        """The rl action matrix: per-class scores over node device types
+        (the PolicyParams.rl_scores leaf the step substitutes)."""
+        return (F.N_JOB_CLASSES, F.N_DEVICE_TYPES)
+
+    def provenance(self, action=None) -> dict:
+        """Policy provenance for bench/detail dicts: the registered policy
+        name(s) + the concrete param digest (with the zero-action default
+        when no action is given), plus the reward variant name."""
+        params = self._params if action is None else self._params.replace(
+            rl_scores=jnp.asarray(action, jnp.float32))
+        return {"policy": self.engine.policy_provenance(params),
+                "reward": self.reward_name}
+
+    # -- reset -------------------------------------------------------------
+
+    def reset(self, key):
+        """One env instance: (obs, EnvState) from a per-env key. Batched
+        form: ``reset_batch`` (vmap over split keys)."""
+        es = EnvState(sim=self._sim0, key=key, t_ep=jnp.int32(0),
+                      episodes=jnp.int32(0), reward_w=self._reward_w)
+        return observe(es.sim, self.cfg), es
+
+    def reset_batch(self, key, n_envs: int):
+        """B env instances with independent streams: the root key is split
+        once and each env owns one branch."""
+        keys = jax.random.split(key, n_envs)
+        return jax.vmap(self.reset)(keys)
+
+    # -- step --------------------------------------------------------------
+
+    def _step(self, es: EnvState, action, sim0: SimState,
+              arr: TickArrivals | None):
+        """Single-env step body (vmapped/jitted by the *_fn builders).
+        ``sim0``/``arr`` ride as broadcast arguments rather than closed-over
+        constants so the compiled step does not bake a copy of the reset
+        state per program."""
+        cfg = self.cfg
+        key, karr = jax.random.split(es.key)
+        if arr is not None:
+            rows = jax.lax.dynamic_index_in_dim(arr.rows, es.t_ep, 0,
+                                                keepdims=False)
+            counts = jax.lax.dynamic_index_in_dim(arr.counts, es.t_ep, 0,
+                                                  keepdims=False)
+        else:
+            g = self.gen
+            rows, counts = tick_arrivals_device(
+                karr, es.sim.t + cfg.tick_ms, self.n_clusters, g.k_max,
+                g.rate, g.max_cores, g.max_mem, g.max_dur_ms, g.beta)
+        params = self._params if action is None else self._params.replace(
+            rl_scores=jnp.asarray(action, jnp.float32))
+        sim2 = self.engine.step_tick(es.sim, rows, counts, params=params)
+
+        placed_d = (jnp.sum(sim2.placed_total)
+                    - jnp.sum(es.sim.placed_total)).astype(jnp.int32)
+        drops_d = _drop_sum(sim2) - _drop_sum(es.sim)
+        wait_s = jnp.mean(st.avg_wait_ms(sim2)) * 1e-3
+        reward = (es.reward_w[0] * (-wait_s)
+                  + es.reward_w[1] * placed_d.astype(jnp.float32)
+                  + es.reward_w[2] * (-drops_d.astype(jnp.float32)))
+
+        done = (es.t_ep + 1) >= self.episode_ticks
+        # auto-reset INSIDE the compiled step: done selects every sim leaf
+        # back to the cached reset constellation — no host round-trip, ever
+        sim3 = jax.tree.map(lambda fresh, cur: jnp.where(done, fresh, cur),
+                            sim0, sim2)
+        es2 = EnvState(
+            sim=sim3, key=key,
+            t_ep=jnp.where(done, jnp.int32(0), es.t_ep + 1),
+            episodes=es.episodes + done.astype(jnp.int32),
+            reward_w=es.reward_w)
+        info = EnvInfo(placed=placed_d, dropped=drops_d,
+                       episodes=es2.episodes, t=sim2.t)
+        return observe(sim3, cfg), reward, done, info, es2
+
+    def step_fn(self, donate: bool = False):
+        """Jitted single-env step: ``(EnvState, action) -> (obs, reward,
+        done, info, EnvState)``. The returned callable's ``_jit`` attribute
+        is the underlying jit function (cache-count probes)."""
+        fn = jax.jit(self._step, donate_argnums=(0,) if donate else ())
+        sim0, arr = self._sim0, self._arr
+
+        def call(es, action=None):
+            return fn(es, action, sim0, arr)
+
+        call._jit = fn
+        return call
+
+    def batch_step_fn(self, donate: bool = True):
+        """The batched step: one compiled program advancing every env —
+        ``(EnvState[B], action[B]) -> (obs[B], reward[B], done[B],
+        info[B], EnvState[B])``. ``donate=True`` (default) donates the
+        EnvState buffers so the whole batch updates in place in HBM; the
+        caller's pre-call EnvState is invalid afterwards (clone with
+        ``jax.tree.map(jnp.copy, es)`` if it must survive). The reset
+        state and replay rows are broadcast arguments — one resident copy,
+        not per-env, not per-program."""
+        v = jax.vmap(self._step, in_axes=(0, 0, None, None))
+        fn = jax.jit(v, donate_argnums=(0,) if donate else ())
+        sim0, arr = self._sim0, self._arr
+
+        def call(es, action=None):
+            return fn(es, action, sim0, arr)
+
+        call._jit = fn
+        return call
+
+
+def shard_env_batch(es: EnvState, mesh, axis: str = "envs"):
+    """Shard a batched EnvState over ``mesh``'s ``axis``: every leaf splits
+    on its leading (env) dimension via the same pytree-prefix placement the
+    cluster mesh uses (parallel/sharded_engine) — envs are independent, so
+    data-parallel jit needs no shard_map and results are bitwise identical
+    to the unsharded batch (tests/test_env.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    from multi_cluster_simulator_tpu.parallel.sharded_engine import (
+        _device_put_tree,
+    )
+
+    return _device_put_tree(es, P(axis), mesh)

@@ -1,0 +1,69 @@
+"""Observation featurization: SimState -> a compact fixed-shape [C, N_OBS]
+float32 tensor a policy head can consume.
+
+The features are deliberately LAYOUT-BLIND: every read goes through the
+accessors both state layouts share — queue ``count`` scalars, the running
+set's ``active`` mask, ``avg_wait_ms``, and node tensors widened through
+``ops/fields.widen`` — so the same observation function works bit-for-bit
+over the wide int32 AoS state and the ``--compact`` SoA state
+(tests/test_env.py pins obs(wide) == obs(compact)). Counts and occupancies
+are normalized by their static capacity bounds so the feature scale is
+shape-independent; free capacity is bucketed by node DEVICE TYPE (the axis
+the rl action matrix scores — ops/fields.N_DEVICE_TYPES), matching the
+action geometry: what the policy can steer is what it observes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.core import state as st
+from multi_cluster_simulator_tpu.core.state import SimState
+from multi_cluster_simulator_tpu.ops import fields as F
+
+# scalar features per cluster, before the per-device-type blocks:
+# 4 queue depths (l0, l1, ready, wait), running occupancy, jobs_in_queue,
+# and the wait-time accrual (avg_wait in seconds)
+_N_SCALAR = 7
+
+
+def n_obs_features(cfg: SimConfig) -> int:
+    """Static observation width per cluster: the scalar block plus, per
+    device type, an active-node fraction and one free-fraction per
+    resource axis."""
+    return _N_SCALAR + F.N_DEVICE_TYPES * (1 + cfg.n_res)
+
+
+def observe(s: SimState, cfg: SimConfig) -> jnp.ndarray:
+    """[C, n_obs_features(cfg)] f32 for one constellation (no env batch
+    axis — the environment vmaps this per env)."""
+    qc = jnp.float32(max(cfg.queue_capacity, 1))
+    run_frac = (jnp.sum(s.run.active, axis=-1).astype(jnp.float32)
+                / jnp.float32(max(cfg.max_running, 1)))
+    scalars = [
+        s.l0.count.astype(jnp.float32) / qc,
+        s.l1.count.astype(jnp.float32) / qc,
+        s.ready.count.astype(jnp.float32) / qc,
+        s.wait.count.astype(jnp.float32) / qc,
+        run_frac,
+        s.jobs_in_queue.astype(jnp.float32) / qc,
+        st.avg_wait_ms(s) * 1e-3,  # seconds — same scale as the reward
+    ]
+    # per-device-type buckets: one-hot over the type axis, contracted
+    # against active/free/cap (no gathers — the env batch vmaps this)
+    free = F.widen(s.node_free).astype(jnp.float32)  # [C, N, R]
+    cap = F.widen(s.node_cap).astype(jnp.float32)
+    active = s.node_active.astype(jnp.float32)  # [C, N]
+    nt = jnp.clip(s.node_type, 0, F.N_DEVICE_TYPES - 1)
+    type_hot = (nt[..., None] == jnp.arange(
+        F.N_DEVICE_TYPES, dtype=jnp.int32)) * active[..., None]  # [C, N, DT]
+    n_nodes = jnp.float32(max(cfg.total_nodes, 1))
+    active_frac = jnp.sum(type_hot, axis=1) / n_nodes  # [C, DT]
+    free_dt = jnp.einsum("cnd,cnr->cdr", type_hot, free)  # [C, DT, R]
+    cap_dt = jnp.einsum("cnd,cnr->cdr", type_hot, cap)
+    free_frac = free_dt / jnp.maximum(cap_dt, 1.0)  # [C, DT, R]
+    C = s.arr_ptr.shape[0]
+    return jnp.concatenate(
+        [jnp.stack(scalars, axis=-1), active_frac,
+         free_frac.reshape(C, F.N_DEVICE_TYPES * cfg.n_res)], axis=-1)

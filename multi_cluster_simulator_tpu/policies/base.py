@@ -41,6 +41,7 @@ from flax import struct
 
 from multi_cluster_simulator_tpu.config import SimConfig
 from multi_cluster_simulator_tpu.core.state import STATE_AXES, SimState
+from multi_cluster_simulator_tpu.ops import fields as F
 from multi_cluster_simulator_tpu.ops import queues as Q
 from multi_cluster_simulator_tpu.policies import kernels as K
 
@@ -59,6 +60,11 @@ class PolicyParams:
     ffd_mem_first: jax.Array  # [] i32 — FFD sort tie-break (0: cores-first)
     gavel_tput: jax.Array  # [N_JOB_CLASSES, N_DEVICE_TYPES] f32 throughput
     tess_w: jax.Array  # [3] f32 — tesserae resource weights (cores/mem/gpu)
+    rl_scores: jax.Array  # [N_JOB_CLASSES, N_DEVICE_TYPES] f32 — the RL
+    #   action port (envs/): per-env NETWORK OUTPUTS scoring node device
+    #   types per job class, fed through the same scored sweep as gavel.
+    #   The zero default makes every score equal, which degenerates to
+    #   first-fit (ops/placement.best_scored_fit ties -> lowest index).
 
 
 # Default Gavel throughput matrix [job class, device type]: gpu-class work
@@ -88,12 +94,12 @@ class PolicySpec:
     different policies."""
 
     name: str
-    kind: str  # "fifo" | "delay" | "ffd" | "gavel" | "tesserae"
+    kind: str  # "fifo" | "delay" | "ffd" | "gavel" | "tesserae" | "rl"
     to_delay: bool
     overrides: tuple = ()
 
 
-KINDS = ("fifo", "delay", "ffd", "gavel", "tesserae")
+KINDS = ("fifo", "delay", "ffd", "gavel", "tesserae", "rl")
 
 REGISTRY: dict[str, PolicySpec] = {}
 
@@ -129,6 +135,10 @@ register(PolicySpec("delay", kind="delay", to_delay=True))
 register(PolicySpec("ffd", kind="ffd", to_delay=True))
 register(PolicySpec("gavel", kind="gavel", to_delay=True))
 register(PolicySpec("tesserae", kind="tesserae", to_delay=True))
+# The RL action port (ROADMAP item 2, envs/): a learned scheduler is this
+# one registered kind — its ``rl_scores`` leaf is a per-env network output
+# the environment's step substitutes per action (envs/cluster_env.py).
+register(PolicySpec("rl", kind="rl", to_delay=True))
 variant("delay-eager", "delay", max_wait_ms=2_000)
 variant("delay-patient", "delay", max_wait_ms=30_000)
 variant("ffd-memfirst", "ffd", ffd_mem_first=1)
@@ -144,6 +154,8 @@ def default_params(cfg: SimConfig, spec: PolicySpec, idx: int = 0) -> PolicyPara
         "ffd_mem_first": np.int32(0),
         "gavel_tput": np.asarray(_DEFAULT_GAVEL_TPUT, np.float32),
         "tess_w": np.asarray(_DEFAULT_TESS_W, np.float32),
+        "rl_scores": np.zeros(
+            (F.N_JOB_CLASSES, F.N_DEVICE_TYPES), np.float32),
     }
     for name, val in spec.overrides:
         if name not in vals:
@@ -153,7 +165,8 @@ def default_params(cfg: SimConfig, spec: PolicySpec, idx: int = 0) -> PolicyPara
                         max_wait_ms=jnp.asarray(vals["max_wait_ms"]),
                         ffd_mem_first=jnp.asarray(vals["ffd_mem_first"]),
                         gavel_tput=jnp.asarray(vals["gavel_tput"]),
-                        tess_w=jnp.asarray(vals["tess_w"]))
+                        tess_w=jnp.asarray(vals["tess_w"]),
+                        rl_scores=jnp.asarray(vals["rl_scores"]))
 
 
 def params_digest(params: PolicyParams) -> str:
@@ -201,6 +214,8 @@ def _run_kind(spec: PolicySpec, state: SimState, t, params, cfg: SimConfig):
               else K._ffd_local)
     elif spec.kind == "gavel":
         fn = K._gavel_local
+    elif spec.kind == "rl":
+        fn = K._rl_local
     else:  # tesserae
         fn = K._tesserae_local
     state = jax.vmap(functools.partial(fn, cfg=cfg, params=params),

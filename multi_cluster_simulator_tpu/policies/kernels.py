@@ -689,19 +689,26 @@ def _fifo_local(s: SimState, t, cfg: SimConfig, params=None):
 # GAVEL — round-based heterogeneity-aware placement (arxiv 2008.09213)
 # --------------------------------------------------------------------------
 
-def _gavel_scores(node_type, jclass, params):
-    """[N] per-node throughput for a job of class ``jclass``: one row of the
-    policy's [N_JOB_CLASSES, N_DEVICE_TYPES] throughput matrix, spread over
-    the node slots by device type. One-hot contractions, no gathers (the
-    kernel is vmapped over thousands of clusters)."""
+def _class_device_scores(node_type, jclass, matrix):
+    """[N] per-node score for a job of class ``jclass``: one row of a
+    [N_JOB_CLASSES, N_DEVICE_TYPES] score matrix, spread over the node
+    slots by device type. One-hot contractions, no gathers (the kernel is
+    vmapped over thousands of clusters). Shared by the gavel kernel (the
+    matrix is a throughput table) and the RL action port (the matrix is a
+    per-env network output — envs/)."""
     jc = jnp.clip(jclass, 0, F.N_JOB_CLASSES - 1)
     row_hot = (jnp.arange(F.N_JOB_CLASSES, dtype=jnp.int32) == jc)
-    row = jnp.einsum("c,cd->d", row_hot.astype(jnp.float32),
-                     params.gavel_tput)  # [DT]
+    row = jnp.einsum("c,cd->d", row_hot.astype(jnp.float32), matrix)  # [DT]
     nt = jnp.clip(node_type, 0, F.N_DEVICE_TYPES - 1)
     nt_hot = (nt[:, None] ==
               jnp.arange(F.N_DEVICE_TYPES, dtype=jnp.int32)[None, :])
     return jnp.einsum("nd,d->n", nt_hot.astype(jnp.float32), row)  # [N]
+
+
+def _gavel_scores(node_type, jclass, params):
+    """Gavel's node scores: the policy's throughput matrix row for the
+    job's class (``_class_device_scores``)."""
+    return _class_device_scores(node_type, jclass, params.gavel_tput)
 
 
 def _tesserae_scores(node_free, job, params):
@@ -795,6 +802,30 @@ def _tesserae_local(s: SimState, t, cfg: SimConfig, params):
 
 
 # --------------------------------------------------------------------------
+# RL — the environment mode's action port (envs/, ROADMAP item 2)
+# --------------------------------------------------------------------------
+
+def _rl_local(s: SimState, t, cfg: SimConfig, params):
+    """The learned-scheduler kind: a Level0 sweep in queue order whose node
+    pick is scored by ``params.rl_scores`` — a [N_JOB_CLASSES,
+    N_DEVICE_TYPES] matrix that in environment mode is a per-env NETWORK
+    OUTPUT substituted per step (envs/cluster_env.py feeds the action in as
+    this leaf). The scores ride the same one-hot class/device-type
+    contraction as gavel (``_class_device_scores``) and the same shared
+    ``_scored_sweep_local`` accounting, so a learned policy can never
+    drift from the zoo on bookkeeping; the zero default scores every node
+    equally, which is exactly first-fit in queue order
+    (P.best_scored_fit ties -> lowest index)."""
+    order = jnp.arange(s.l0.capacity, dtype=jnp.int32)  # queue order
+
+    def score(s2, job):
+        return _class_device_scores(s2.node_type, job.jclass,
+                                    params.rl_scores)
+
+    return _scored_sweep_local(s, t, cfg, params, order, score)
+
+
+# --------------------------------------------------------------------------
 # leap-accrual masks (the event-compressed driver's closed-form wait)
 # --------------------------------------------------------------------------
 
@@ -804,7 +835,7 @@ def leap_wait_masks(kind: str, s: SimState, cfg: SimConfig, params=None):
     ``_record_wait`` on when nothing places: (l0_mask, l1_mask), single
     cluster view. FIFO records no wait in the pass; DELAY processes the
     first ``min(|L1|, QC)`` Level1 slots plus the Level0 head; the Level0
-    sweeps (FFD/gavel/tesserae) record their first ``min(|L0|, QC)``
+    sweeps (FFD/gavel/tesserae/rl) record their first ``min(|L0|, QC)``
     processed slots — in sweep order, which for the sorted sweeps means
     the first n positions of the (possibly param-swapped) BFD order.
     ``kind`` is the policy KIND (static — one mask shape per registered
@@ -822,8 +853,8 @@ def leap_wait_masks(kind: str, s: SimState, cfg: SimConfig, params=None):
         l0_mask = jnp.logical_and(
             jnp.arange(cap0, dtype=jnp.int32) == 0, s.l0.count > 0)
         return l0_mask, l1_mask
-    if kind == "gavel":
-        # queue-order sweep: the first min(|L0|, QC) slots ARE positions
+    if kind in ("gavel", "rl"):
+        # queue-order sweeps: the first min(|L0|, QC) slots ARE positions
         l0_mask = jnp.logical_and(
             s.l0.slot_valid(),
             jnp.arange(cap0, dtype=jnp.int32) < jnp.minimum(s.l0.count, QC))

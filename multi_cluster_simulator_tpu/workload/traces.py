@@ -108,3 +108,66 @@ def from_arrays(t_ms, cores, mem, dur_ms, gpus=None) -> Arrivals:
     return _pack(np.asarray(t_ms), np.asarray(cores), np.asarray(mem),
                  np.asarray(dur_ms),
                  None if gpus is None else np.asarray(gpus))
+
+
+def tick_arrivals_device(key, t, n_clusters: int, k_max: int, rate,
+                         max_cores, max_mem, max_dur_ms, beta=2.0):
+    """[jax] One tick's arrival rows drawn ON DEVICE — the environment
+    mode's generative workload (envs/cluster_env.py): the same
+    distribution family as ``uniform_stream`` (Beta(b,b) sizes, uniform
+    durations), but sampled per tick from an explicit PRNG key instead of
+    host numpy, so thousands of vmapped env instances each carry their own
+    stream with zero host round-trips. Per-(tick, cluster) arrival counts
+    are Binomial(k_max, rate/k_max) — the per-tick marginal of ``rate *
+    n_ticks`` jobs landing uniformly over the horizon, truncated at the
+    static fanout bound ``k_max``.
+
+    Returns ``(rows [C, K, NF] i32, counts [C] i32)`` in exactly the
+    TickArrivals per-tick slice shape ``Engine.step_tick`` ingests; row
+    order/sentinels come from the canonical schema (ops/fields.py), the
+    same one site the host pack paths derive theirs from. ``key`` must be
+    a per-env stream key (simlint: env-rng); ``t`` is the tick's clock —
+    it becomes the rows' ``enq_t``, so wait accounting starts at arrival
+    exactly as the host-bucketed path's does."""
+    import jax
+    import jax.numpy as jnp
+
+    from multi_cluster_simulator_tpu.ops import fields as F
+    from multi_cluster_simulator_tpu.ops import queues as Q
+
+    C, K = int(n_clusters), int(k_max)
+    ka, kc, km, kd = jax.random.split(key, 4)
+    # candidates are iid, so "count admitted, take the row prefix" draws
+    # the same joint distribution as compacting the admitted rows — and
+    # ingest consumes exactly the [0, count) prefix (_ingest_packed_local)
+    admit = jax.random.uniform(ka, (C, K)) < (
+        jnp.float32(rate) / jnp.float32(K))
+    counts = jnp.sum(admit, axis=1).astype(jnp.int32)
+
+    def beta_bb(k, shape):
+        # Beta(b, b) for integer b as the b-th order statistic of 2b-1
+        # uniforms (exact). jax.random.beta lowers to rejection-sampled
+        # gamma while_loops, which under the env vmap cost ~25x the whole
+        # tick on CPU; a sort over 3 uniforms (b=2) is pure vector ops.
+        b = int(beta)
+        if b != beta or b < 1:  # non-integer b: the general (slow) sampler
+            return jax.random.beta(k, beta, beta, shape)
+        u = jax.random.uniform(k, (*shape, 2 * b - 1))
+        return jnp.sort(u, axis=-1)[..., b - 1]
+
+    cores = jnp.floor(beta_bb(kc, (C, K)) * max_cores).astype(jnp.int32)
+    mem = jnp.floor(beta_bb(km, (C, K)) * max_mem).astype(jnp.int32)
+    dur = jax.random.randint(kd, (C, K), 0, max(int(max_dur_ms), 1),
+                             dtype=jnp.int32)
+    tt = jnp.broadcast_to(jnp.asarray(t, jnp.int32), (C, K))
+    gpu = jnp.zeros((C, K), jnp.int32)
+    # ids are tick-local (the generative stream has no global cursor);
+    # nothing in the tick keys on id uniqueness — the borrowed-row match
+    # compares (id, cores, mem, dur) and env configs run borrowing off
+    ids = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None, :], (C, K))
+    vals = {"id": ids, "cores": cores, "mem": mem, "gpu": gpu, "dur": dur,
+            "enq_t": tt, "owner": jnp.full((C, K), int(Q.OWN), jnp.int32),
+            "rec_wait": jnp.zeros((C, K), jnp.int32),
+            "jclass": F.job_class(cores, gpu).astype(jnp.int32)}
+    rows = jnp.stack([vals[n] for n in F.QUEUE_FIELDS], axis=-1)
+    return rows, counts

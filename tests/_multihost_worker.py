@@ -14,12 +14,44 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def cpu_cross_process_collectives():
+    """The CPU client's cross-process collectives implementation name, or
+    None when this jaxlib cannot run multiprocess computations on CPU.
+
+    jaxlib's CPU client defaults to NO collectives implementation: the mesh
+    forms and sharded inputs commit, but the first multiprocess computation
+    fails at dispatch with "INVALID_ARGUMENT: Multiprocess computations
+    aren't implemented on the CPU backend". Builds that ship the gloo TCP
+    implementation (jaxlib >= 0.4.36 here) run them once
+    ``jax_cpu_collectives_implementation`` selects it — which must happen
+    before any backend init, so the worker does it first thing and the
+    test module uses the same probe as its skip condition. Deliberately
+    import-light: probing must not itself initialize a backend."""
+    try:
+        from jax._src.lib import xla_extension
+    except ImportError:  # pragma: no cover - ancient jaxlib
+        return None
+    if hasattr(xla_extension, "make_gloo_tcp_collectives"):
+        return "gloo"
+    return None
+
+
 def main():
     coordinator, pid, nprocs = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
 
     # distributed init MUST precede any package import: the package builds
     # jnp constants at import time, which initializes the XLA backend
     import jax
+
+    # The CPU client defaults to NO cross-process collectives implementation
+    # — a multiprocess computation then fails at dispatch with
+    # "Multiprocess computations aren't implemented on the CPU backend" —
+    # so select the gloo TCP implementation when this jaxlib ships it.
+    # Must happen before any backend init (the client is built with the
+    # collectives baked in); tests/test_multihost.py skips when absent.
+    impl = cpu_cross_process_collectives()
+    if impl is not None:
+        jax.config.update("jax_cpu_collectives_implementation", impl)
 
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=nprocs, process_id=pid)

@@ -1,0 +1,17 @@
+"""env-rng fixture (BAD): shared-key reuse across the env batch.
+
+Three violation shapes the rule must each surface: a module-level constant
+key, a sampler drawing from that non-derived key inside the step path, and
+an inline fresh-key construction feeding a draw — under vmap every env
+instance receives IDENTICAL samples from all three."""
+
+import jax
+
+_SHARED = jax.random.PRNGKey(0)  # fresh key minted at module level
+
+
+def step(es: "EnvState", action):  # noqa: F821 - fixture type name only
+    noise = jax.random.uniform(_SHARED, (4,))  # key not derived from EnvState
+    k = jax.random.PRNGKey(7)  # fresh key minted inside the step
+    draw = jax.random.normal(k, (2,))
+    return es, noise.sum() + draw.sum()

@@ -1,0 +1,20 @@
+"""env-rng fixture (GOOD): the per-env key discipline.
+
+Every draw derives from the EnvState key (split-folded) or from a key
+argument the caller threads in — fresh keys are never minted here, so each
+vmapped env instance owns an independent stream."""
+
+import jax
+
+
+def step(es: "EnvState", action):  # noqa: F821 - fixture type name only
+    key, sub = jax.random.split(es.key)
+    noise = jax.random.uniform(sub, (4,))
+    branches = jax.random.split(key, 3)
+    extra = jax.random.normal(branches[0], (2,))
+    return es.replace(key=key), noise.sum() + extra.sum()
+
+
+def reset_batch(root_key, n_envs):
+    keys = jax.random.split(root_key, n_envs)
+    return jax.random.uniform(keys[0], (n_envs,))

@@ -69,6 +69,7 @@ FIXTURE_RULES = [
     ("bad_det_chunk_sync.py", "det-chunk-sync"),
     ("bad_compact_store.py", "compact-store"),
     ("bad_policy_kernel.py", "policy-kernel"),
+    ("bad_env_rng.py", "env-rng"),
     ("bad_pragma.py", "pragma-no-reason"),
     ("bad_pragma.py", "pragma-stale"),
 ]
@@ -171,6 +172,54 @@ def test_policy_kernel_scopes_the_kernels_module():
     modules, _ = load_target(str(PKG_DIR))
     assert any(m.relpath in POLICY_KERNEL_FILES for m in modules), \
         "policies/kernels.py not loaded — the policy-kernel scope is empty"
+
+
+def test_bad_env_rng_flags_every_violation_shape():
+    """The fixture carries three shapes — a module-level constant key, a
+    sampler drawing from it inside the step, and an inline fresh-key
+    construction feeding a draw — and each must surface as its own finding
+    (the draw from the freshly minted key counts as a fourth: its key is
+    not derived either)."""
+    findings = [f for f in run(str(FIXTURES / "bad_env_rng.py"))
+                if f.rule == "env-rng"]
+    assert len(findings) == 4, "\n".join(f.render() for f in findings)
+
+
+def test_good_env_rng_fixture_is_clean():
+    """The paired clean version — split of the EnvState key, branch keys by
+    indexing the split, a key argument threaded by the caller — must NOT
+    trip env-rng (or anything else)."""
+    findings = run(str(FIXTURES / "good_env_rng.py"))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    proc = _cli(str(FIXTURES / "good_env_rng.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_env_rng_reaches_the_real_env(tmp_path):
+    """env-rng provably engages with envs/cluster_env.py's real step path:
+    replace the per-env key split with a constant shared key and the rule
+    must fire — so the package analyzing clean can never mean 'checked
+    nothing'."""
+    src = (PKG_DIR / "envs" / "cluster_env.py").read_text()
+    anchor = "        key, karr = jax.random.split(es.key)\n"
+    bad = src.replace(
+        anchor,
+        "        key, karr = jax.random.split(jax.random.PRNGKey(0))\n", 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "cluster_env_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "env-rng" for x in run(str(f)))
+
+
+def test_env_rng_scopes_the_envs_package():
+    """The family actually runs over envs/ inside the package (a clean
+    result must mean 'checked and clean', not 'not in scope')."""
+    from tools.simlint.runner import ENV_RNG_DIRS
+
+    modules, _ = load_target(str(PKG_DIR))
+    tops = {m.relpath.split("/", 1)[0] for m in modules if m.relpath}
+    assert set(ENV_RNG_DIRS) <= tops, \
+        "envs/ not loaded — the env-rng scope is empty"
 
 
 def test_good_chunk_pipeline_fixture_is_clean():

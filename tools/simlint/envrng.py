@@ -1,0 +1,190 @@
+"""env-rng pass: per-env PRNG discipline in the environment package.
+
+The batched gym (envs/) holds thousands of vmapped env instances whose
+ONLY source of independence is the key each ``EnvState`` carries: a
+``jax.random.*`` call whose key does not derive from that state (or from a
+key argument threaded in by the caller) is evaluated once and SHARED
+across the whole batch axis — every env draws the same arrivals, the
+"independent replications" are one replication copied B times, and
+nothing crashes. The canonical violation is a module-level or inline
+``jax.random.PRNGKey(0)`` feeding a sampler inside the step path
+(tests/fixtures/simlint/bad_env_rng.py).
+
+Two checks over every scope in envs/ (module level included):
+
+- **fresh-key construction** — any ``jax.random.PRNGKey``/``jax.random.key``
+  call: keys must flow IN (from EnvState or a caller argument), never be
+  minted inside the environment package where they cannot be per-env.
+- **underived sampler key** — a ``jax.random`` call (``uniform``,
+  ``split``, ``normal``, ...) whose first argument does not trace, through
+  local assignments, to a *derived* source: a parameter whose name
+  contains ``key``/``rng``, any ``.key`` attribute (the EnvState leaf), or
+  the result of ``jax.random.split``/``fold_in``/``clone`` on a derived
+  value (tuple unpacking and indexing included).
+
+Scoping: one scope per outermost function (nested closures share their
+parent's keys — the batched step builders close over split results), plus
+the module level. Scoped to ``envs/`` in the package; a standalone file is
+treated as env code only when it references ``EnvState``
+(``module_is_env``) — the same single-file convention gate the
+policy-kernel family uses.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+RULE = "env-rng"
+
+# calls that TRANSFORM a key into derived child keys (their result is
+# derived when their first argument is)
+_DERIVERS = frozenset({"split", "fold_in", "clone", "wrap_key_data"})
+_FRESH = frozenset({"PRNGKey", "key"})
+
+
+def module_is_env(mod: Module) -> bool:
+    """Single-file convention gate: standalone targets match every scope,
+    so the family only engages with files that actually look like env code
+    (reference EnvState) — otherwise every other family's fixtures would
+    pick up spurious findings."""
+    return "EnvState" in mod.source
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _random_fn(call: ast.Call, mod: Module) -> str:
+    """Resolve a Call to its ``jax.random`` function name ('' if the call
+    is not a jax.random one). Handles ``jax.random.X``, ``jr.X`` (import
+    jax.random as jr), ``random.X`` (from jax import random), and bare
+    ``X`` (from jax.random import X)."""
+    d = _dotted(call.func)
+    if not d:
+        return ""
+    head, _, rest = d.partition(".")
+    if rest:
+        full = mod.module_aliases.get(head)
+        if full == "jax" and rest.startswith("random."):
+            return rest.split(".", 1)[1]
+        if full == "jax.random" and "." not in rest:
+            return rest
+        if mod.from_imports.get(head) == ("jax", "random") and "." not in rest:
+            return rest
+        return ""
+    src = mod.from_imports.get(head)
+    if src is not None and src[0] == "jax.random":
+        return src[1]
+    return ""
+
+
+def _is_keyname(name: str) -> bool:
+    low = name.lower()
+    return "key" in low or "rng" in low
+
+
+class _KeyFlow:
+    """Assignment-level dataflow over one scope: which local names hold a
+    DERIVED key (rooted in a key/rng parameter or an EnvState ``.key``
+    read). Deliberately flow-INSENSITIVE (all assignments seed before any
+    check): a linter should miss a pathological use-before-assign rather
+    than false-positive on ordinary code motion."""
+
+    def __init__(self, scope, mod: Module):
+        self.mod = mod
+        self.derived: set[str] = set()
+        if scope is not None:
+            for node in ast.walk(scope):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    a = node.args
+                    for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                        if _is_keyname(arg.arg):
+                            self.derived.add(arg.arg)
+
+    def expr_derived(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.derived or _is_keyname(node.id)
+        if isinstance(node, ast.Attribute):
+            # EnvState's per-env key leaf (es.key, carry.state.key, ...)
+            return _is_keyname(node.attr) or self.expr_derived(node.value)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self.expr_derived(node.value)
+        if isinstance(node, ast.Call):
+            fn = _random_fn(node, self.mod)
+            return bool(fn in _DERIVERS and node.args
+                        and self.expr_derived(node.args[0]))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return bool(node.elts) and all(self.expr_derived(e)
+                                           for e in node.elts)
+        return False
+
+    def seed(self, scope_nodes) -> None:
+        # two passes: derived-ness can chain through one intermediate name
+        for _ in range(2):
+            for node in scope_nodes:
+                if isinstance(node, ast.Assign) and self.expr_derived(node.value):
+                    for tgt in node.targets:
+                        for leaf in ast.walk(tgt):
+                            if isinstance(leaf, ast.Name):
+                                self.derived.add(leaf.id)
+
+
+def _check_scope(scope, scope_nodes, mod: Module, out: list[Finding]) -> None:
+    flow = _KeyFlow(scope, mod)
+    flow.seed(scope_nodes)
+    for node in scope_nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        name = _random_fn(node, mod)
+        if not name:
+            continue
+        if name in _FRESH:
+            out.append(Finding(
+                mod.path, node.lineno, RULE,
+                f"jax.random.{name} mints a fresh key inside envs/ — keys "
+                "must flow in from EnvState (jax.random.split of the "
+                "per-env key), never be constructed where they cannot be "
+                "per-env"))
+        elif not (node.args and flow.expr_derived(node.args[0])):
+            out.append(Finding(
+                mod.path, node.lineno, RULE,
+                f"jax.random.{name}'s key does not derive from EnvState/a "
+                "key argument — a non-per-env key is SHARED across the "
+                "whole vmapped env batch (every env draws identical "
+                "samples)"))
+
+
+def _outermost_functions(tree) -> list:
+    out = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(child)
+            else:
+                visit(child)
+
+    visit(tree)
+    return out
+
+
+def check_module(mod: Module) -> list[Finding]:
+    out: list[Finding] = []
+    fns = _outermost_functions(mod.tree)
+    inside = {id(n) for f in fns for n in ast.walk(f)}
+    module_nodes = [n for n in ast.walk(mod.tree) if id(n) not in inside]
+    _check_scope(None, module_nodes, mod, out)
+    for f in fns:
+        _check_scope(f, list(ast.walk(f)), mod, out)
+    out.sort(key=lambda f: (f.line, f.message))
+    return out

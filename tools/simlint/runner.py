@@ -6,14 +6,15 @@ from __future__ import annotations
 from typing import Iterable, Optional
 
 from tools.simlint import (
-    compactstore, determinism, findings as F, lockset, policykernel, purity,
+    compactstore, determinism, envrng, findings as F, lockset, policykernel,
+    purity,
 )
 from tools.simlint.callgraph import CallGraph
 from tools.simlint.project import Module, in_scope, load_target
 
 # package-relative scopes per family (ISSUE 2): the jitted tick path for
 # purity, the threaded hosts for locks, tick+market for determinism
-PURITY_DIRS = ("core", "ops", "parallel", "market")
+PURITY_DIRS = ("core", "ops", "parallel", "market", "envs")
 PURITY_EXTRA_FILES = ("services/host_ops.py",)
 LOCKSET_DIRS = ("services",)
 # workload/ builds the arrival streams the replay contract starts from —
@@ -32,9 +33,14 @@ COMPACT_RULES = ("compact-store",)
 # reachability — plus the params-are-traced-data obligation (ISSUE 6)
 POLICY_KERNEL_FILES = ("policies/kernels.py",)
 POLICY_KERNEL_RULES = ("policy-kernel",)
+# the batched gym (envs/): per-env PRNG-stream discipline — every
+# jax.random call's key must derive from EnvState / a key argument
+# (shared-key reuse across the vmapped batch is the canonical bug, ISSUE 7)
+ENV_RNG_DIRS = ("envs",)
+ENV_RNG_RULES = ("env-rng",)
 PRAGMA_RULES = ("pragma-no-reason", "pragma-stale")
 ALL_RULES = (PURITY_RULES + LOCKSET_RULES + DET_RULES + COMPACT_RULES
-             + POLICY_KERNEL_RULES + PRAGMA_RULES)
+             + POLICY_KERNEL_RULES + ENV_RNG_RULES + PRAGMA_RULES)
 
 
 def run(target: str, rules: Optional[Iterable[str]] = None,
@@ -67,6 +73,10 @@ def run(target: str, rules: Optional[Iterable[str]] = None,
                 mod.relpath != "" or policykernel.module_takes_params(mod)):
             raw += policykernel.check_module(mod)
             checked.update(POLICY_KERNEL_RULES)
+        if in_scope(mod, ENV_RNG_DIRS) and (
+                mod.relpath != "" or envrng.module_is_env(mod)):
+            raw += envrng.check_module(mod)
+            checked.update(ENV_RNG_RULES)
 
     if selected is not None:
         raw = [f for f in raw if f.rule in selected]
