@@ -144,7 +144,7 @@ def _peak_hbm_bytes():
 
 
 def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
-                repeats=3, warmups=0, tick_indexed=False):
+                repeats=3, warmups=0, tick_indexed=False, mesh_devices=None):
     """Advance n_ticks in jitted chunks (one device call per chunk — a single
     multi-minute executable can trip device RPC deadlines).
 
@@ -164,7 +164,12 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     ``warmups`` runs extra untimed repeats after the compile run: the first
     timed runs behind the shared TPU tunnel are reliably the slowest (r04
     headline walls 8.2/9.2 s before settling at ~5 s), which inflated the
-    min-vs-median spread the judge audits."""
+    min-vs-median spread the judge audits.
+
+    ``mesh_devices`` pins the mesh size instead of taking every visible
+    device — the weak-scaling driver (tools/weak_scaling.py) sweeps
+    1/2/4/8-device rows inside one 8-device process; ``mesh_devices=1``
+    forces the single-device engine as the curve's baseline row."""
     import jax
     import jax.numpy as jnp
 
@@ -196,7 +201,7 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
         info = {"ran_ticks": n_ticks,
                 "placed_before_resume": int(np.asarray(state.placed_total).sum()),
                 "resumed_at_tick": done}
-    n_dev = len(jax.devices())
+    n_dev = mesh_devices if mesh_devices is not None else len(jax.devices())
     chunks = [chunk] * (n_ticks // chunk)
     if n_ticks % chunk:
         chunks.append(n_ticks % chunk)
@@ -272,6 +277,7 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     if use_mesh and n_dev > 1 and state.arr_ptr.shape[0] % n_dev == 0:
         from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
         sh = ShardedEngine(cfg, make_mesh(n_dev))
+        info["mesh_devices"] = n_dev
         # policy provenance from the engine that actually runs (registered
         # name + param digest) — joinable with tournament rows and other
         # BENCH_*.json rounds
@@ -438,7 +444,7 @@ def _timing_detail(info):
     for k in ("pipeline", "h2d_bytes", "arrivals_bytes",
               "peak_hbm_process_bytes", "compile_cache", "time_compress",
               "state_bytes", "tick_bytes_accessed", "tick_bytes_note",
-              "compact", "policy"):
+              "compact", "policy", "mesh_devices"):
         if info.get(k) is not None:
             out[k] = info[k]
     return out
@@ -1352,7 +1358,7 @@ def bench_tournament(quick=False):
     else:
         detail = run_tournament(policies=sweep_policies(), n_seeds=4, C=8,
                                 jobs_per=56, horizon_ms=30_000,
-                                drain_ticks=40)
+                                drain_ticks=40, device_ab="auto")
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "tools", "tournament.json"), "w") as f:
             json.dump(detail, f, indent=2)
@@ -1474,6 +1480,57 @@ def bench_env(quick=False):
     wall = min(walls)
     rate = B * steps / max(wall, 1e-9)
 
+    # trace-parallel mode (ROADMAP item 3b): the env batch axis is pure
+    # replication, so it shards over the device mesh with NO exchange —
+    # data-parallel jit splits the leading axis per device. Measured
+    # device speedup + a bitwise gate proving sharding invisible (the
+    # same replication-sharding contract the tournament's seed axis has).
+    trace_parallel = None
+    n_dev = len(jax.devices())
+    if n_dev > 1 and B % n_dev == 0:
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from multi_cluster_simulator_tpu.envs import shard_env_batch
+
+        mesh = Mesh(np.asarray(jax.devices()), ("envs",))
+        # a fresh jit: the sharded executable must not share (or pollute)
+        # the unsharded step's compile-count gate above
+        sh_step = env.batch_step_fn(donate=True)
+        sh_action = jax.device_put(action, NamedSharding(mesh, P("envs")))
+
+        def run_sharded(es):
+            for _ in range(steps):
+                obs, r, d, i_, es = sh_step(es, sh_action)
+            jax.block_until_ready(es)
+            return es
+
+        def fresh_sharded():
+            return jax.block_until_ready(
+                shard_env_batch(jax.tree.map(jnp.copy, es0), mesh))
+
+        es_fin_sh = run_sharded(fresh_sharded())  # compile run
+        sh_walls = []
+        for _ in range(2 if quick else 3):
+            es_in = fresh_sharded()
+            t0 = time.time()
+            es_fin_sh = run_sharded(es_in)
+            np.asarray(es_fin_sh.sim.t)
+            sh_walls.append(time.time() - t0)
+        for la, lb in zip(jax.tree.leaves(es_fin_sh),
+                          jax.tree.leaves(es_fin)):
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+                "sharded env batch diverges from the unsharded batch — "
+                "replication sharding must be bitwise invisible")
+        sh_rate = B * steps / max(min(sh_walls), 1e-9)
+        trace_parallel = {
+            "devices": n_dev,
+            "envs_steps_per_sec": round(sh_rate, 1),
+            "walls": [round(w, 3) for w in sh_walls],
+            "speedup_vs_unsharded": round(sh_rate / max(rate, 1e-9), 2),
+            "bit_identical_to_unsharded": True,
+        }
+
     # serial baseline: the SAME per-env work, one env instance per step
     # call — the host-stepped-gym dispatch pattern. envs·steps/sec is a
     # per-env-step rate, so a smaller serial sample compares 1:1.
@@ -1534,11 +1591,68 @@ def bench_env(quick=False):
         **env.provenance(),
         "backend": jax.default_backend(), "devices": len(jax.devices()),
     }
+    if trace_parallel is not None:
+        detail["trace_parallel"] = trace_parallel
     return {
         "metric": "env_mode_envs_steps_per_sec",
         "value": round(rate, 1),
         "unit": "env-steps/s",
         "vs_baseline": round(speedup, 2),
+        "detail": detail,
+    }
+
+
+def bench_multichip(quick=False):
+    """Weak-scaling constellation record (tools/weak_scaling.py, ROADMAP
+    item 3): per-device-count rows (1/2/4/8) of the headline FIFO-parity
+    semantics at ~4k clusters/device, the federated-market composition row,
+    and the Borg-scale 10M+-job streamed record, written to
+    MULTICHIP_r06.json with per-row backend/device provenance.
+
+    Runs in a child process re-exec'd with the virtual-device count pinned
+    before jax initializes (same pattern as __graft_entry__.
+    dryrun_multichip — the device count is fixed at backend init, so the
+    8-device mesh cannot be formed in this process). Quick mode runs the
+    1/2-device CI smoke curve to a temp record — tools/weak_scaling.py
+    itself refuses to clobber the full record with --quick output (the
+    cost_probe guard)."""
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = (os.path.join("/tmp", "multichip_quick.json") if quick
+           else os.path.join(root, "MULTICHIP_r06.json"))
+    args = [sys.executable, os.path.join(root, "tools", "weak_scaling.py"),
+            "--out", out]
+    if quick:
+        args += ["--quick", "--devices", "1", "2", "--min-efficiency", "0.5"]
+    proc = subprocess.run(args, cwd=root, capture_output=True, text=True,
+                          timeout=14_400)
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"weak_scaling driver failed rc={proc.returncode}:\n"
+            f"{proc.stderr[-4000:]}")
+    with open(out) as f:
+        rec = json.load(f)
+    top = rec["rows"][-1]
+    detail = {"record_path": out, "curve": [
+        {k: r.get(k) for k in ("n_devices", "clusters", "jobs_per_sec",
+                               "efficiency_vs_linear", "ticks_executed",
+                               "ticks_simulated")} for r in rec["rows"]],
+        "parity_cells": len(rec.get("parity_cells", [])),
+        "bottleneck": rec.get("bottleneck"),
+        "backend": rec.get("backend"), "policy": top.get("policy")}
+    for k in ("market_row", "record"):
+        if rec.get(k):
+            detail[k] = {kk: rec[k].get(kk) for kk in (
+                "kind", "n_devices", "clusters", "jobs", "jobs_per_sec",
+                "ticks_executed", "ticks_simulated", "virtual_nodes_traded")
+                if rec[k].get(kk) is not None}
+    return {
+        "metric": "weak_scaling_jobs_per_sec_max_mesh",
+        "value": top["jobs_per_sec"],
+        "unit": "jobs/s",
+        "vs_baseline": round(top["jobs_per_sec"] / (1_000_000 / 60.0), 3),
         "detail": detail,
     }
 
@@ -1557,6 +1671,7 @@ CONFIGS = {
     "live": bench_live,
     "tournament": bench_tournament,
     "env": bench_env,
+    "multichip": bench_multichip,
 }
 
 
@@ -1595,6 +1710,12 @@ def main():
                     help="shorthand for --config env: batched RL-environment "
                          "stepping (envs/) — envs·steps/sec with auto-reset, "
                          "per-env PRNG streams, and the serial-loop A/B")
+    ap.add_argument("--multichip", action="store_true",
+                    help="shorthand for --config multichip: the weak-scaling "
+                         "constellation record (tools/weak_scaling.py) — "
+                         "per-device-count curve + federated-market "
+                         "composition + the 10M+-job streamed record, "
+                         "written to MULTICHIP_r06.json")
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true",
                     help="shrunk shapes for smoke-testing the harness")
@@ -1641,6 +1762,8 @@ def main():
         args.config = "tournament"
     if args.env_bench:
         args.config = "env"
+    if args.multichip:
+        args.config = "multichip"
     _setup_jax(args.compile_cache_dir, not args.no_compile_cache)
     _CKPT["path"] = args.checkpoint
     _CKPT["resume"] = args.resume
@@ -1696,14 +1819,14 @@ def main():
 
         _PIPELINE["mode"] = "on" if args.pipeline == "ab" else args.pipeline
         res = call()
-        if args.pipeline == "ab" and name not in ("parity_tpu", "live", "tournament", "env"):
+        if args.pipeline == "ab" and name not in ("parity_tpu", "live", "tournament", "env", "multichip"):
             ab_compare(res, _PIPELINE, "on", "pipeline_ab",
                        "pipelined", "unpipelined")
-        if args.time_compress == "ab" and name not in ("parity_tpu", "live", "tournament", "env"):
+        if args.time_compress == "ab" and name not in ("parity_tpu", "live", "tournament", "env", "multichip"):
             ab_compare(res, _TIME_COMPRESS, "auto", "time_compress_ab",
                        "compressed", "dense",
                        extra=("ticks_executed", "ticks_simulated"))
-        if args.compact == "ab" and name not in ("parity_tpu", "live", "tournament", "env"):
+        if args.compact == "ab" and name not in ("parity_tpu", "live", "tournament", "env", "multichip"):
 
             def compact_gates(d, doff, ab):
                 # correctness gate, not just walls: the wide re-run must
@@ -1749,6 +1872,10 @@ def main():
     if args.all:
         results = {}
         for name in CONFIGS:
+            if name == "multichip":
+                # the weak-scaling record has its own artifact
+                # (MULTICHIP_r06.json) and cadence — run it explicitly
+                continue
             results[name] = run_one(name)
             print(f"# {name}: {results[name]['metric']} = "
                   f"{results[name]['value']} {results[name]['unit']}",

@@ -289,11 +289,23 @@ def shard_env_batch(es: EnvState, mesh, axis: str = "envs"):
     on its leading (env) dimension via the same pytree-prefix placement the
     cluster mesh uses (parallel/sharded_engine) — envs are independent, so
     data-parallel jit needs no shard_map and results are bitwise identical
-    to the unsharded batch (tests/test_env.py)."""
+    to the unsharded batch (tests/test_env.py). The replication-sharding
+    half of trace-parallel mode (ROADMAP item 3b): bench.py --env-bench
+    records the measured device speedup when the mesh has more than one
+    device."""
     from jax.sharding import PartitionSpec as P
 
+    from multi_cluster_simulator_tpu.parallel.mesh import nearest_divisible
     from multi_cluster_simulator_tpu.parallel.sharded_engine import (
         _device_put_tree,
     )
 
+    n = mesh.shape[axis]
+    B = es.t_ep.shape[0] if es.t_ep.ndim else 1
+    if B % n != 0:
+        lo, hi = nearest_divisible(B, n)
+        valid = f"{hi}" if lo == 0 else f"{lo} or {hi}"
+        raise ValueError(
+            f"env batch ({B}) must divide by mesh size ({n}); nearest "
+            f"valid batch sizes: {valid}")
     return _device_put_tree(es, P(axis), mesh)

@@ -24,3 +24,14 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "clusters",
             raise ValueError(f"need {n_devices} devices, have {len(devs)}")
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis,))
+
+
+def nearest_divisible(c: int, n: int) -> tuple[int, int]:
+    """The two cluster counts bracketing ``c`` that divide evenly over an
+    ``n``-way mesh: ``(floor, ceil)`` multiples of ``n`` (floor can be 0).
+    Shared by ShardedEngine.shard_inputs' failure message and the
+    weak-scaling driver's sentinel auto-pad (tools/weak_scaling.py pads up
+    to the ceil count)."""
+    lo = (c // n) * n
+    hi = lo if lo == c else lo + n
+    return lo, hi

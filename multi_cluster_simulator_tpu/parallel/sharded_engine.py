@@ -88,7 +88,16 @@ class ShardedEngine:
         n = self.mesh.shape[self.axis]
         C = state.arr_ptr.shape[0]
         if C % n != 0:
-            raise ValueError(f"clusters ({C}) must divide by mesh size ({n})")
+            from multi_cluster_simulator_tpu.parallel.mesh import (
+                nearest_divisible,
+            )
+            lo, hi = nearest_divisible(C, n)
+            valid = f"{hi}" if lo == 0 else f"{lo} or {hi}"
+            raise ValueError(
+                f"clusters ({C}) must divide by mesh size ({n}); nearest "
+                f"valid cluster counts: {valid} (tools/weak_scaling.py "
+                f"auto-pads to {hi} with inert always-full sentinel "
+                "clusters)")
         return (self.shard_state(state, place),
                 self.shard_arrivals(arrivals, place))
 

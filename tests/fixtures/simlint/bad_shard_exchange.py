@@ -1,0 +1,34 @@
+"""Fixture: raw cross-shard collectives + host-side shard inspection in
+engine-style code — everything the shard-exchange family must flag.
+
+Five violation shapes: a jax.lax collective through the full dotted path,
+one through the ``lax`` module alias, one imported bare, a hardcoded
+axis_index, and the two host-side inspections (.addressable_shards,
+jax.device_get) inside what reads as a shard-mapped tick body.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.lax import psum
+
+
+def borrow_match_tick(state, want):
+    # BAD: raw pmin — single-device runs have no axis in scope, and the
+    # hardcoded name couples the code to one mesh layout
+    winner = jax.lax.pmin(want, "clusters")
+    # BAD: all_gather through the lax alias
+    rows = lax.all_gather(state, "clusters", axis=0, tiled=True)
+    # BAD: bare collective import
+    total = psum(want, "clusters")
+    # BAD: hardcoded axis_index instead of ex.offset
+    off = jax.lax.axis_index("clusters")
+    return winner, rows, total, off
+
+
+def readback_in_body(out):
+    # BAD: host-side shard inspection inside the mapped body
+    parts = [s.data for s in out.addressable_shards]
+    # BAD: device_get mid-tick
+    host = jax.device_get(out)
+    return parts, host, jnp.sum(host)

@@ -1,0 +1,23 @@
+"""Fixture: the paired clean form — cross-shard decisions routed through
+the Exchange interface (parallel/exchange.py), readback left to the host
+driver. Mentions the collective tokens only through ``ex.*`` calls, so the
+single-file convention gate engages and the pass must still find nothing.
+"""
+
+import jax.numpy as jnp
+
+
+def borrow_match_tick(state, want, ex):
+    # the sanctioned route: ex.allmin is lax.pmin under MeshExchange and
+    # the identity under LocalExchange — one code path, both regimes
+    winner = ex.allmin(want)
+    rows = ex.gather(state)
+    total = ex.allsum(want.astype(jnp.float32))
+    off = ex.offset(want.shape[0])
+    return winner, rows, total, off
+
+
+def quiescence_vote(sig_equal, ex):
+    # the event-compressed driver's cross-shard vote (alland == pmin of
+    # the 0/1 form): every shard must agree before any shard leaps
+    return ex.alland(sig_equal)

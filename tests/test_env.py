@@ -121,6 +121,45 @@ def test_env_batch_sharded_over_mesh_matches_unsharded():
     assert _trees_equal(cell, ref)
 
 
+def test_env_batch_sharded_composed_with_compact_matches_unsharded():
+    """Trace-parallel replication sharding composed with the compact SoA
+    state plan: the sharded batch must stay bitwise identical to the
+    unsharded batch AND every cell to the standalone compact run_jit —
+    narrow storage dtypes shard over the env axis like the wide layout."""
+    from jax.sharding import Mesh
+
+    from multi_cluster_simulator_tpu.core.compact import derive_plan
+
+    cfg = _cfg()
+    specs = _specs()
+    arr, ta = _replay(cfg)
+    plan = derive_plan(cfg, specs, arr)
+    env = ClusterEnv(cfg, specs, episode_ticks=T + 5, arrivals=ta, plan=plan)
+    B = 8
+    _, es = env.reset_batch(jax.random.PRNGKey(4), B)
+    es_sh = shard_env_batch(es, Mesh(np.asarray(jax.devices()), ("envs",)))
+    step = env.batch_step_fn(donate=False)
+    for _ in range(T):
+        _, _, _, _, es = step(es, None)
+        _, _, _, _, es_sh = step(es_sh, None)
+    assert _trees_equal(es.sim, es_sh.sim)
+    cell = jax.tree.map(lambda a: a[5], es_sh.sim)
+    assert _trees_equal(cell, _run_ref(cfg, specs, ta, T, plan=plan))
+
+
+def test_shard_env_batch_rejects_indivisible_batch_with_nearest_counts():
+    """A batch that doesn't divide over the mesh fails fast, naming the
+    nearest valid batch sizes (the shard_inputs contract, ROADMAP 3b)."""
+    from jax.sharding import Mesh
+
+    cfg = _cfg()
+    _, ta = _replay(cfg)
+    env = ClusterEnv(cfg, _specs(), episode_ticks=T + 5, arrivals=ta)
+    _, es = env.reset_batch(jax.random.PRNGKey(2), 6)
+    with pytest.raises(ValueError, match=r"nearest valid batch sizes: 8"):
+        shard_env_batch(es, Mesh(np.asarray(jax.devices()), ("envs",)))
+
+
 def test_constructor_rejects_invalid_modes():
     cfg = _cfg()
     specs = _specs()

@@ -186,6 +186,44 @@ class TestTournamentEquivalence:
         for r in detail["rows"]:
             assert r["policy"] in REGISTRY and len(r["params_digest"]) == 12
 
+    def test_trace_parallel_sharded_cells_match_standalone(self):
+        """Trace-parallel mode (ROADMAP 3b): the replication (seed) axis
+        sharded over a 2-device mesh — every cell still bit-identical to
+        its standalone single-policy run (run_tournament's internal gate),
+        AND the device A/B's direct sharded==single-device grid comparison
+        holds. Sharding must be invisible to replay."""
+        from tools.tournament import run_tournament
+
+        detail = run_tournament(
+            policies=("fifo", "delay"), n_seeds=2, C=8, jobs_per=24,
+            horizon_ms=60_000, drain_ticks=30, shard_seeds="always",
+            shard_devices=2, device_ab=True)
+        assert detail["replication_axis_sharded"]
+        assert detail["devices"] == 2
+        assert detail["compiled_programs"] == 1
+        assert detail["cells_bit_identical_to_standalone"]
+        ab = detail["replication_shard_ab"]
+        assert ab["grids_bit_identical"] and ab["devices"] == 2
+
+    def test_shard_always_that_cannot_engage_raises(self):
+        """An explicitly requested shard/device-A/B that cannot engage
+        must fail, not silently run unsharded — otherwise the CI gate
+        could exit 0 having verified nothing."""
+        import pytest
+
+        from tools.tournament import run_tournament
+
+        with pytest.raises(AssertionError, match="cannot engage"):
+            run_tournament(policies=("fifo",), n_seeds=2, C=4, jobs_per=8,
+                           horizon_ms=5_000, drain_ticks=5,
+                           verify_cells=False, shard_seeds="always",
+                           shard_devices=1)
+        with pytest.raises(AssertionError, match="device-ab requires"):
+            run_tournament(policies=("fifo",), n_seeds=3, C=4, jobs_per=8,
+                           horizon_ms=5_000, drain_ticks=5,
+                           verify_cells=False, shard_seeds="auto",
+                           shard_devices=2, device_ab=True)
+
 
 class TestZooBehavior:
     def test_best_scored_fit_prefers_high_score_ties_low_index(self):

@@ -119,6 +119,101 @@ def test_cluster_count_must_divide():
         sh.shard_inputs(init_state(cfg, specs), arrivals)
 
 
+def test_divisibility_error_names_nearest_valid_counts():
+    """The shard_inputs failure mode names the nearest valid cluster
+    counts (floor and ceil multiples of the mesh size) so the caller can
+    resize — or point tools/weak_scaling.py's sentinel auto-pad at it."""
+    cfg = SimConfig(policy=PolicyKind.DELAY, max_nodes=12)
+    specs = _specs(13)
+    arrivals = make_arrivals(cfg, 13, horizon_ms=10_000, seed=1)
+    state = init_state(cfg, specs)
+    with pytest.raises(ValueError, match=r"nearest valid cluster counts: "
+                                         r"12 or 16"):
+        ShardedEngine(cfg, make_mesh(4)).shard_inputs(state, arrivals)
+    # below one full mesh there is no floor count to suggest
+    with pytest.raises(ValueError, match=r"nearest valid cluster counts: 8"):
+        ShardedEngine(cfg, make_mesh(8)).shard_inputs(
+            init_state(cfg, _specs(6)),
+            make_arrivals(cfg, 6, horizon_ms=10_000, seed=1))
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_weak_scaling_tiny_mesh_composed_bit_equality(n_dev):
+    """The weak-scaling constellation at CI scale: the driver's own
+    FIFO-parity shape on a tiny mesh must equal the single-device run of
+    the same TOTAL shape leaf-for-leaf, composed with the compact SoA
+    layout AND the event-compressed driver (quiescence votes + leaps ride
+    the exchange, so all shards jump together)."""
+    from multi_cluster_simulator_tpu.core.compact import derive_plan
+    from multi_cluster_simulator_tpu.core.engine import pack_arrivals_by_tick
+    from tools.weak_scaling import _fifo_constellation
+
+    cfg, specs, arrivals, n_ticks = _fifo_constellation(16, 10, 30_000,
+                                                        seed=41)
+    plan = derive_plan(cfg, specs, arrivals)
+    ta = pack_arrivals_by_tick(arrivals, n_ticks, cfg.tick_ms)
+    s0 = init_state(cfg, specs, plan=plan)
+    ref = Engine(cfg).run_jit()(s0, ta, n_ticks)
+
+    sh = ShardedEngine(cfg, make_mesh(n_dev))
+    out, stats = sh.run_fn(n_ticks, tick_indexed=True, time_compress=True)(
+        sh.shard_state(s0), sh.shard_arrivals(ta))
+    _assert_states_equal(ref, out)
+    assert int(np.asarray(stats.ticks_executed)) < n_ticks  # it leapt
+    check_conservation(out)
+
+
+def test_sentinel_padding_bit_identical_on_unpadded_prefix():
+    """tools/weak_scaling.pad_constellation: a 13-cluster constellation
+    padded to 16 for the 4-way mesh must evolve the REAL clusters exactly
+    as the unpadded single-device run — sentinels (zero-capacity nodes,
+    zero arrivals) can never place, lend, or borrow — and the sentinels
+    themselves must stay inert. Composed with borrowing, the cross-shard
+    path a visible sentinel would perturb first."""
+    from multi_cluster_simulator_tpu.core.engine import pack_arrivals_by_tick
+    from tools.weak_scaling import pad_constellation
+
+    cfg = SimConfig(policy=PolicyKind.FIFO, borrowing=True,
+                    queue_capacity=64, max_running=128, max_arrivals=256,
+                    max_nodes=12,
+                    workload=WorkloadConfig(poisson_lambda_per_min=30.0))
+    C = 13
+    specs = _specs(C)
+    arrivals = make_arrivals(cfg, C, horizon_ms=90_000, seed=47,
+                             max_cores=16, max_mem=8_000)
+    T = 90
+    ta = pack_arrivals_by_tick(arrivals, T, cfg.tick_ms)
+    ref = Engine(cfg).run_jit()(init_state(cfg, specs), ta, T)
+
+    pspecs, parr, n_pad = pad_constellation(cfg, specs, arrivals, 4)
+    assert n_pad == 3 and len(pspecs) == 16
+    sh = ShardedEngine(cfg, make_mesh(4))
+    pta = pack_arrivals_by_tick(parr, T, cfg.tick_ms)
+    out = sh.run_fn(T, tick_indexed=True)(
+        sh.shard_state(init_state(cfg, pspecs)), sh.shard_arrivals(pta))
+    for la, lb in zip(jax.tree.leaves(out), jax.tree.leaves(ref)):
+        a, b = np.asarray(la), np.asarray(lb)
+        if a.ndim and a.shape[0] == 16:
+            a = a[:C]
+        np.testing.assert_array_equal(a, b)
+    assert int(np.asarray(out.placed_total)[C:].sum()) == 0
+    assert int(np.asarray(out.borrowed.count)[C:].sum()) == 0
+    check_conservation(out)
+
+
+def test_sentinel_padding_refused_under_trader():
+    """Market padding is NOT invisible (sentinel utilization snapshots
+    enter the request/approve policies) — pad_constellation must refuse."""
+    from tools.weak_scaling import pad_constellation
+
+    cfg = SimConfig(policy=PolicyKind.DELAY, max_nodes=12,
+                    max_virtual_nodes=4, trader=TraderConfig(enabled=True))
+    specs = _specs(6)
+    arrivals = make_arrivals(cfg, 6, horizon_ms=10_000, seed=1)
+    with pytest.raises(ValueError, match="cannot auto-pad"):
+        pad_constellation(cfg, specs, arrivals, 4)
+
+
 def test_time_compressed_sharded_matches_local():
     """Event compression in the mesh regime: run_fn(time_compress=True) on
     the 8-device mesh must equal the single-device DENSE engine leaf for

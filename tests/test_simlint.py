@@ -70,6 +70,7 @@ FIXTURE_RULES = [
     ("bad_compact_store.py", "compact-store"),
     ("bad_policy_kernel.py", "policy-kernel"),
     ("bad_env_rng.py", "env-rng"),
+    ("bad_shard_exchange.py", "shard-exchange"),
     ("bad_pragma.py", "pragma-no-reason"),
     ("bad_pragma.py", "pragma-stale"),
 ]
@@ -220,6 +221,72 @@ def test_env_rng_scopes_the_envs_package():
     tops = {m.relpath.split("/", 1)[0] for m in modules if m.relpath}
     assert set(ENV_RNG_DIRS) <= tops, \
         "envs/ not loaded — the env-rng scope is empty"
+
+
+def test_bad_shard_exchange_flags_every_violation_shape():
+    """The fixture carries six shapes — a full-dotted pmin, an all_gather
+    through the lax alias, a bare-imported psum, a hardcoded axis_index,
+    an .addressable_shards inspection, and a mid-body device_get — and
+    each must surface as its own finding."""
+    findings = [f for f in run(str(FIXTURES / "bad_shard_exchange.py"))
+                if f.rule == "shard-exchange"]
+    assert len(findings) == 6, "\n".join(f.render() for f in findings)
+
+
+def test_good_shard_exchange_fixture_is_clean():
+    """The paired clean form — the same decisions routed through the
+    Exchange interface — must NOT trip shard-exchange (or anything else)."""
+    findings = run(str(FIXTURES / "good_shard_exchange.py"))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    proc = _cli(str(FIXTURES / "good_shard_exchange.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_shard_exchange_reaches_the_real_engine(tmp_path):
+    """shard-exchange provably engages with core/engine.py's real borrow
+    path: replace the sanctioned ex.allmin with a raw hardcoded-axis
+    lax.pmin and the rule must fire — so the package analyzing clean can
+    never mean 'checked nothing'."""
+    src = (PKG_DIR / "core" / "engine.py").read_text()
+    anchor = "    winner = ex.allmin(local_best)"
+    bad = src.replace(
+        anchor, '    winner = jax.lax.pmin(local_best, "clusters")', 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "engine_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "shard-exchange" for x in run(str(f)))
+
+
+def test_shard_exchange_sees_through_plain_import_jax_lax(tmp_path):
+    """A plain ``import jax.lax`` binds the name ``jax`` to the ROOT
+    package while the alias table records 'jax.lax' — the resolver must
+    not let that import style make ``jax.lax.psum`` (or ``jax.device_get``)
+    invisible, or the whole family is one import away from a bypass."""
+    f = tmp_path / "bypass.py"
+    f.write_text(
+        "import jax\n"
+        "import jax.lax\n\n\n"
+        "def tick(x):\n"
+        "    y = jax.lax.psum(x, 'clusters')\n"
+        "    return jax.device_get(y)\n")
+    found = [x for x in run(str(f)) if x.rule == "shard-exchange"]
+    assert len(found) == 2, "\n".join(x.render() for x in found)
+
+
+def test_shard_exchange_sanctions_the_exchange_module():
+    """parallel/exchange.py IS the sanctioned collective module: its raw
+    lax.pmin/pmax/all_gather implementations must not self-flag (the
+    package-clean test covers this implicitly; this pins the reason)."""
+    from tools.simlint.runner import SHARD_EXCHANGE_DIRS
+
+    modules, _ = load_target(str(PKG_DIR))
+    ex_mod = [m for m in modules if m.relpath == "parallel/exchange.py"]
+    assert ex_mod, "parallel/exchange.py not loaded"
+    from tools.simlint import shardexchange
+    assert shardexchange.check_module(ex_mod[0]) == []
+    tops = {m.relpath.split("/", 1)[0] for m in modules if m.relpath}
+    assert set(SHARD_EXCHANGE_DIRS) <= tops, \
+        "shard-exchange scope dirs not all loaded"
 
 
 def test_good_chunk_pipeline_fixture_is_clean():

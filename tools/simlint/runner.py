@@ -7,7 +7,7 @@ from typing import Iterable, Optional
 
 from tools.simlint import (
     compactstore, determinism, envrng, findings as F, lockset, policykernel,
-    purity,
+    purity, shardexchange,
 )
 from tools.simlint.callgraph import CallGraph
 from tools.simlint.project import Module, in_scope, load_target
@@ -38,9 +38,18 @@ POLICY_KERNEL_RULES = ("policy-kernel",)
 # (shared-key reuse across the vmapped batch is the canonical bug, ISSUE 7)
 ENV_RNG_DIRS = ("envs",)
 ENV_RNG_RULES = ("env-rng",)
+# cross-shard discipline (ISSUE 9): raw lax collectives / host-side shard
+# inspection outside parallel/'s sanctioned exchange helpers — the scope is
+# every package dir the sharded engine traces through, plus parallel/
+# itself (exchange.py/multihost.py are the sanctioned modules, excluded
+# inside the pass)
+SHARD_EXCHANGE_DIRS = ("core", "ops", "market", "envs", "policies",
+                       "workload", "parallel")
+SHARD_EXCHANGE_RULES = ("shard-exchange",)
 PRAGMA_RULES = ("pragma-no-reason", "pragma-stale")
 ALL_RULES = (PURITY_RULES + LOCKSET_RULES + DET_RULES + COMPACT_RULES
-             + POLICY_KERNEL_RULES + ENV_RNG_RULES + PRAGMA_RULES)
+             + POLICY_KERNEL_RULES + ENV_RNG_RULES + SHARD_EXCHANGE_RULES
+             + PRAGMA_RULES)
 
 
 def run(target: str, rules: Optional[Iterable[str]] = None,
@@ -77,6 +86,11 @@ def run(target: str, rules: Optional[Iterable[str]] = None,
                 mod.relpath != "" or envrng.module_is_env(mod)):
             raw += envrng.check_module(mod)
             checked.update(ENV_RNG_RULES)
+        if in_scope(mod, SHARD_EXCHANGE_DIRS) and (
+                mod.relpath != ""
+                or shardexchange.module_is_shard_scope(mod)):
+            raw += shardexchange.check_module(mod)
+            checked.update(SHARD_EXCHANGE_RULES)
 
     if selected is not None:
         raw = [f for f in raw if f.rule in selected]

@@ -1,0 +1,162 @@
+"""shard-exchange pass: cross-shard traffic goes through parallel/exchange.
+
+The engine runs the SAME code single-device and inside ``shard_map`` over a
+mesh; the only thing that changes is the ``Exchange`` implementation
+(LocalExchange identities vs MeshExchange collectives). That contract is
+what makes shard count invisible to replay (PARITY.md): every cross-shard
+decision is written once against the ``ex.*`` interface and the identity
+form proves the collective form. A raw ``jax.lax`` collective in engine
+code breaks it two ways — single-device runs crash (no axis in scope) or,
+worse, a hardcoded axis name silently couples the code to one mesh layout
+— and a host-side shard inspection inside a mapped body desyncs shards or
+stalls the dispatch pipeline. Two checks over the sharding-sensitive scope
+(core/ops/market/envs/policies/workload + parallel/ itself):
+
+- **raw collective** — any ``jax.lax`` collective call (``psum``, ``pmin``,
+  ``pmax``, ``pmean``, ``all_gather``, ``all_to_all``, ``ppermute``,
+  ``pshuffle``, ``psum_scatter``, ``pbroadcast``, ``axis_index``) outside
+  the one sanctioned module, ``parallel/exchange.py``. Engine code must
+  call the ``Exchange`` methods (``ex.gather``/``allmin``/``allmax``/
+  ``allsum``/``alland``/``offset``) so the single-device identity semantics
+  stay the oracle for the mesh semantics.
+- **host-side shard inspection** — ``.addressable_shards`` reads or
+  ``jax.device_get`` calls: host-only APIs that have no meaning inside a
+  traced/shard-mapped body. Result readback belongs in the host drivers
+  (bench.py, tools/) or ``parallel/multihost.py``'s sanctioned
+  ``gather_to_host``.
+
+Scoping: the package dirs above, with ``parallel/exchange.py`` sanctioned
+for collectives and ``parallel/multihost.py`` for host-side gathering. A
+standalone file engages the family only when it mentions a collective or
+shard-inspection token (``module_is_shard_scope``) — the same single-file
+convention gate the env-rng family uses.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+RULE = "shard-exchange"
+
+_COLLECTIVES = frozenset({
+    "psum", "pmin", "pmax", "pmean", "all_gather", "all_to_all", "ppermute",
+    "pshuffle", "psum_scatter", "pbroadcast", "axis_index",
+})
+_HOST_CALLS = frozenset({"device_get"})
+_HOST_ATTRS = frozenset({"addressable_shards"})
+
+# files inside the package where the flagged APIs are the point
+COLLECTIVE_SANCTIONED = ("parallel/exchange.py",)
+HOST_SANCTIONED = ("parallel/exchange.py", "parallel/multihost.py")
+
+
+def module_is_shard_scope(mod: Module) -> bool:
+    """Single-file convention gate: engage only with files that actually
+    touch collective/shard APIs, so other families' fixtures don't pick up
+    spurious findings."""
+    src = mod.source
+    return (any(name in src for name in _COLLECTIVES)
+            or any(name in src for name in _HOST_ATTRS)
+            or "device_get" in src)
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _bound_module(head: str, mod: Module) -> str:
+    """The dotted module a bare name is actually bound to. A plain
+    ``import jax.lax`` records ``module_aliases['jax'] = 'jax.lax'`` but
+    binds the name ``jax`` to the ROOT package (submodule imports bind the
+    root; only an ``as`` alias binds the submodule) — resolving the alias
+    value literally would make ``jax.lax.psum`` and ``jax.device_get``
+    both invisible after such an import."""
+    full = mod.module_aliases.get(head)
+    if full is None:
+        return ""
+    root = full.split(".", 1)[0]
+    return root if head == root else full
+
+
+def _lax_fn(call: ast.Call, mod: Module) -> str:
+    """Resolve a Call to its ``jax.lax`` function name ('' if not one).
+    Handles ``jax.lax.X`` (incl. after a plain ``import jax.lax``),
+    ``lax.X`` (from jax import lax / import jax.lax as lax), and bare
+    ``X`` (from jax.lax import X)."""
+    d = _dotted(call.func)
+    if not d:
+        return ""
+    head, _, rest = d.partition(".")
+    if rest:
+        bound = _bound_module(head, mod)
+        if bound == "jax" and rest.startswith("lax.") \
+                and rest.count(".") == 1:
+            return rest.split(".", 1)[1]
+        if bound == "jax.lax" and "." not in rest:
+            return rest
+        if mod.from_imports.get(head) == ("jax", "lax") and "." not in rest:
+            return rest
+        return ""
+    src = mod.from_imports.get(head)
+    if src is not None and src[0] == "jax.lax":
+        return src[1]
+    return ""
+
+
+def _jax_fn(call: ast.Call, mod: Module) -> str:
+    """Resolve a Call to its top-level ``jax`` function name ('' if not)."""
+    d = _dotted(call.func)
+    head, _, rest = d.partition(".")
+    if rest and "." not in rest and _bound_module(head, mod) == "jax":
+        return rest
+    if not rest:
+        src = mod.from_imports.get(head)
+        if src is not None and src[0] == "jax":
+            return src[1]
+    return ""
+
+
+def check_module(mod: Module) -> list[Finding]:
+    out: list[Finding] = []
+    allow_coll = mod.relpath in COLLECTIVE_SANCTIONED
+    allow_host = mod.relpath in HOST_SANCTIONED
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = _lax_fn(node, mod)
+            if name in _COLLECTIVES and not allow_coll:
+                out.append(Finding(
+                    mod.path, node.lineno, RULE,
+                    f"raw cross-shard collective lax.{name} outside "
+                    "parallel/exchange.py — route it through the Exchange "
+                    "interface (ex.gather/allmin/allmax/allsum/alland/"
+                    "offset) so the single-device identity semantics stay "
+                    "the oracle for the mesh semantics"))
+                continue
+            jname = _jax_fn(node, mod)
+            if jname in _HOST_CALLS and not allow_host:
+                out.append(Finding(
+                    mod.path, node.lineno, RULE,
+                    "jax.device_get in sharding-sensitive code — host-side "
+                    "readback has no meaning inside a shard-mapped body; "
+                    "collect results in the host driver or via "
+                    "parallel/multihost.gather_to_host"))
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _HOST_ATTRS and not allow_host:
+                out.append(Finding(
+                    mod.path, node.lineno, RULE,
+                    ".addressable_shards inspected in sharding-sensitive "
+                    "code — per-shard buffers are host-side state; "
+                    "shard-mapped bodies see only their local block, and "
+                    "result readback belongs in the host driver"))
+    out.sort(key=lambda f: (f.line, f.message))
+    return out

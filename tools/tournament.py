@@ -155,7 +155,7 @@ def _cell_stats(state, C, jobs_per):
 
 def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
                    horizon_ms=240_000, drain_ticks=80, verify_cells=True,
-                   shard_seeds="auto"):
+                   shard_seeds="auto", device_ab=False, shard_devices=None):
     """Run the (policy, seed) grid; returns the tournament detail dict.
 
     Gates (raise on violation — CI runs this via bench.py --tournament):
@@ -163,6 +163,19 @@ def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
     - every cell's final state is bit-identical to its standalone
       single-policy run (``verify_cells``);
     - no cell drops work (bounds sized for the lineup).
+
+    ``device_ab=True`` (with a sharded replication axis) re-runs the whole
+    grid through a FRESH jit over single-device inputs and records both
+    walls + the measured device speedup in
+    ``detail["replication_shard_ab"]`` — plus a direct bitwise comparison
+    of the two grids (sharding must be invisible). The re-run uses its own
+    jit so the main compile-count gate stays exactly one program.
+    ``device_ab=True`` raises if the replication axis cannot shard (a gate
+    that silently verifies nothing is worse than a failure);
+    ``device_ab="auto"`` runs the A/B only when sharding engaged (the
+    bench full record, which also runs single-device).
+    ``shard_devices`` caps the replication mesh at the first N devices
+    (CI runs a 2-device cell on the 8-virtual-device suite mesh).
     """
     import jax
     import jax.numpy as jnp
@@ -203,15 +216,40 @@ def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
 
     fn = jax.jit(grid_fn)
 
-    # trace-parallel mode: shard the replication (seed) axis over devices
-    n_dev = len(jax.devices())
+    # trace-parallel mode: shard the replication (seed) axis over devices.
+    # auto engages only on non-CPU backends: a host-CPU "mesh" is virtual
+    # devices time-slicing cores XLA's intra-op threadpool already uses, so
+    # sharding there only adds partitioning overhead (measured on the
+    # 2-core CI host: 0.77x at the C=64 default lineup, 0.56x at the
+    # bench full sweep's C=8 micro-cells — tools/tournament_shard_ab.json);
+    # "always" forces it anyway, which is what the equality gates and the
+    # honest A/B records use.
+    devs = jax.devices()[:shard_devices] if shard_devices else jax.devices()
+    n_dev = len(devs)
     sharded = (shard_seeds == "always"
-               or (shard_seeds == "auto" and n_dev > 1)) \
+               or (shard_seeds == "auto" and n_dev > 1
+                   and jax.default_backend() != "cpu")) \
         and n_seeds % max(n_dev, 1) == 0 and n_dev > 1
+    # an explicit request that cannot engage must fail, not silently run
+    # unsharded — otherwise the CI gate ("--shard always --device-ab")
+    # would exit 0 having verified nothing if the multi-device env var is
+    # ever dropped or the seed count stops dividing
+    if shard_seeds == "always" and not sharded:
+        raise AssertionError(
+            f"--shard always cannot engage: {n_dev} device(s), {n_seeds} "
+            "seeds — need >1 device and a seed count divisible by it")
+    if device_ab and not sharded:
+        if device_ab == "auto":  # bench full mode: A/B only when sharded
+            device_ab = False
+        else:
+            raise AssertionError(
+                "--device-ab requires a sharded replication axis "
+                f"({n_dev} device(s), {n_seeds} seeds)")
+    stacked_host = stacked  # pre-placement copy for the device A/B re-run
     if sharded:
         from jax.sharding import Mesh, NamedSharding
         from jax.sharding import PartitionSpec as P
-        mesh = Mesh(np.array(jax.devices()), ("replications",))
+        mesh = Mesh(np.array(devs), ("replications",))
         stacked = jax.tree.map(
             lambda a: jax.device_put(a, NamedSharding(mesh,
                                                       P("replications"))),
@@ -235,6 +273,34 @@ def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
             f"tournament compiled {cache_size} programs for "
             f"{len(policies)}x{n_seeds} cells — compile count must be "
             "independent of sweep size (exactly one)")
+
+    shard_ab = None
+    if device_ab and sharded:
+        # the measured trace-parallel win: the SAME grid through a fresh
+        # jit over single-device inputs (one compile each side — walls
+        # compare runs only), plus the direct bitwise gate
+        fn1 = jax.jit(grid_fn)
+        one = [jax.block_until_ready(fn1(state0, stacked_host, p))
+               for p in variant_params]  # compile + correctness run
+        for g_sh, g_1 in zip(grid, one):
+            for la, lb in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_1)):
+                if not np.array_equal(np.asarray(la), np.asarray(lb)):
+                    raise AssertionError(
+                        "sharded replication grid diverges from the "
+                        "single-device grid — sharding must be invisible")
+        t0 = time.time()
+        for p in variant_params:
+            jax.block_until_ready(fn1(state0, stacked_host, p))
+        one_wall = time.time() - t0
+        t0 = time.time()
+        for p in variant_params:
+            jax.block_until_ready(fn(state0, stacked, p))
+        sh_wall = time.time() - t0
+        shard_ab = {"devices": n_dev,
+                    "sharded_wall_s": round(sh_wall, 3),
+                    "single_device_wall_s": round(one_wall, 3),
+                    "device_speedup": round(one_wall / max(sh_wall, 1e-9), 2),
+                    "grids_bit_identical": True}
 
     # serial per-policy loop: the pre-zoo workflow (one Engine, one trace,
     # one compile per variant — the market_ab shape) — both the recorded
@@ -302,6 +368,7 @@ def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
         "backend": jax.default_backend(), "devices": n_dev,
         "replication_axis_sharded": bool(sharded),
         "compiled_programs": cache_size,
+        **({"replication_shard_ab": shard_ab} if shard_ab else {}),
         "pack_once_s": round(pack_s, 3),
         "tournament_wall_s": round(tournament_wall, 3),
         "cells_bit_identical_to_standalone": bool(verify_cells),
@@ -327,13 +394,23 @@ def main(argv=None):
     ap.add_argument("--no-verify", action="store_true",
                     help="skip the per-cell standalone equality check "
                          "(also skips the serial baseline wall)")
+    ap.add_argument("--shard", choices=("auto", "always", "never"),
+                    default="auto",
+                    help="shard the replication (seed) axis over the device "
+                         "mesh (trace-parallel mode; auto = when >1 device "
+                         "and the seed count divides)")
+    ap.add_argument("--device-ab", action="store_true",
+                    help="also run the grid single-device through a fresh "
+                         "jit and record the measured device speedup + the "
+                         "bitwise sharded==unsharded gate")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tournament.json"))
     args = ap.parse_args(argv)
     kw = dict(policies=tuple(args.policies), n_seeds=args.seeds,
               C=args.clusters, jobs_per=args.jobs,
               horizon_ms=args.horizon_ms,
-              verify_cells=not args.no_verify)
+              verify_cells=not args.no_verify,
+              shard_seeds=args.shard, device_ab=args.device_ab)
     if args.quick:
         kw.update(policies=tuple(args.policies[:4]) if len(args.policies) > 4
                   else tuple(args.policies),
