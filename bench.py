@@ -1269,6 +1269,357 @@ def bench_live(quick=False):
     }
 
 
+def bench_serving(quick=False):
+    """The serving tier, measured (services/serving.py, ROADMAP item 4):
+    the async batched front door that takes live traffic from the
+    per-request path's 113 jobs/s (BENCH ``live``) into the 10k+ regime by
+    coalescing staged arrivals across ticks and clusters into ONE
+    ``Engine.run_io`` dispatch per window, with donated device-resident
+    state and snapshot-backed query endpoints.
+
+    Three phases, every gate enforced on every run (quick included):
+
+    1. **parity A/B** (deterministic paced, over real HTTP): the same
+       trace through a window-1 front door (the per-request cost model:
+       one dispatch per tick, one POST per job) and a window-W front door
+       (batch POSTs, one dispatch per W ticks). The final device states
+       must be BIT-IDENTICAL — coalescing is invisible to placement — and
+       the batched wall must beat the per-request wall.
+    2. **throughput** (wall-clock): concurrent synthetic clients slam
+       /submitBatch with retry-on-503 semantics; reported value is placed
+       jobs per wall second end-to-end (first submit -> last placed).
+       Zero engine drops required — saturation must surface as quoted
+       503s, never silent loss.
+    3. **latency** (wall-clock, record_trace on): clients pace an offered
+       rate ~60% of phase 2's measure; p50/p99 submit-to-placed-visible
+       latency from the device trace + the snapshot visibility log.
+
+    Runs in a subprocess pinned to host CPU (the live-bench pattern: an
+    engine colocated with its host is the deployment shape measured;
+    the tunnel-attached TPU pays ~0.5 s per dispatch)."""
+    import subprocess
+    import time as _time
+
+    if os.environ.get("MCS_SERVING_CHILD") != "1":
+        env = dict(os.environ)
+        env["MCS_SERVING_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_PLATFORM_NAME"] = "cpu"
+        for k in list(env):
+            if k.startswith(("TPU_", "LIBTPU")) or k == "PJRT_DEVICE":
+                env.pop(k)
+        args = [sys.executable, os.path.abspath(__file__),
+                "--config", "serving"]
+        if quick:
+            args.append("--quick")
+        proc = subprocess.run(args, env=env, capture_output=True, text=True,
+                              cwd=os.path.dirname(os.path.abspath(__file__)),
+                              timeout=3600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"serving child failed rc={proc.returncode}:\n"
+                f"{proc.stderr[-4000:]}")
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        for line in proc.stderr.splitlines():
+            if line.startswith("# detail: "):
+                result["detail"] = json.loads(line[len("# detail: "):])
+        return result
+
+    import threading
+
+    import jax
+
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.services import httpd
+    from multi_cluster_simulator_tpu.services.scheduler_host import (
+        job_to_json,
+    )
+    from multi_cluster_simulator_tpu.services.serving import ServingScheduler
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+
+    C = 8 if quick else 16
+    WINDOW = 8
+    K_WARM = (16, 64, 128)
+
+    def mkcfg(trace_events=None):
+        # queue_capacity 256: measured sweet spot — 384 raises the
+        # admission budget but the per-tick queue ops scale with capacity
+        # and the net throughput DROPS ~10%; 256 keeps the tick lean
+        return SimConfig(
+            policy=PolicyKind.FIFO, parity=True, n_res=2,
+            queue_capacity=256, max_running=512, max_arrivals=64,
+            max_ingest_per_tick=16, max_nodes=10, max_virtual_nodes=0,
+            record_trace=trace_events is not None,
+            max_trace_events=trace_events or 1)
+
+    specs = [uniform_cluster(c + 1, 10) for c in range(C)]
+
+    def assert_clean(s, label, expect_placed):
+        drops = total_drops(s.state_host())
+        assert all(v == 0 for v in drops.values()), (
+            f"serving[{label}]: engine dropped work ({drops}) — "
+            "back-pressure must surface saturation as 503s, never drops")
+        placed = s.snapshot.placed
+        assert placed == expect_placed, (
+            f"serving[{label}]: placed {placed} != submitted "
+            f"{expect_placed}")
+        return drops
+
+    # ---------------- phase 1: parity A/B over real HTTP ----------------
+    # a sparse deterministic trace (about 1 job/cluster/tick) so dispatch
+    # cost — what coalescing amortizes — dominates the comparison; the
+    # same submission sequence drives both windows
+    T_AB = 80 if quick else 320
+    rng = np.random.default_rng(11)
+    tick_jobs = []  # [T][...] of (c, id, cores, mem, dur, endpoint_delay)
+    jid = 1
+    for t in range(T_AB):
+        row = []
+        for c in range(C):
+            for _ in range(int(rng.integers(0, 3))):
+                # one in ~20 jobs hits the endpoint the policy never
+                # drains (endpoint-faithful routing must be window-
+                # invariant too)
+                mism = bool(rng.integers(0, 20) == 0)
+                row.append((c, jid, int(rng.integers(1, 4)),
+                            int(rng.integers(100, 2000)),
+                            int(rng.integers(1000, 4001)), mism))
+                jid += 1
+        tick_jobs.append(row)
+    n_ab = sum(len(r) for r in tick_jobs)
+
+    def drive_ab(window, batched_api):
+        s = ServingScheduler("serve-ab", specs, mkcfg(), pacer=False,
+                             window=window, warm_k=(4,), k_cap=64,
+                             max_staged=10 ** 6)
+        s.start()
+        t0 = _time.time()
+        for t in range(T_AB):
+            if batched_api:
+                # the front door's native path: one POST carries the
+                # tick's whole job buffer (per-job Delay flags preserve
+                # endpoint-faithful routing)
+                if tick_jobs[t]:
+                    code, _ = httpd.post_json(
+                        s.url + "/submitBatch",
+                        [{**job_to_json(j, cores, mem, dur), "Cluster": c,
+                          "Delay": mism}
+                         for (c, j, cores, mem, dur, mism) in tick_jobs[t]])
+                    assert code == 200, f"batch submit tick {t} -> {code}"
+            else:
+                for (c, j, cores, mem, dur, mism) in tick_jobs[t]:
+                    # per-request cost model: one POST per job on the
+                    # wire-parity endpoints (FIFO policy drains "/";
+                    # "/delay" is the mismatched endpoint)
+                    ep = "/delay" if mism else "/"
+                    code, _ = httpd.post_json(
+                        s.url + ep, {**job_to_json(j, cores, mem, dur),
+                                     "Cluster": c})
+                    assert code == 200, f"submit {j} -> {code}"
+            s.seal_tick()
+            if (t + 1) % window == 0:
+                s.dispatch_sealed()
+        s.dispatch_sealed()
+        wall = _time.time() - t0
+        state = s.state_host()
+        mismatched = sum(1 for r in tick_jobs for jj in r if jj[5])
+        assert_clean(s, f"ab-w{window}", n_ab - mismatched)
+        s.shutdown()
+        return state, wall, s
+
+    state_1, wall_1, _s1 = drive_ab(1, batched_api=False)
+    state_w, wall_w, _sw = drive_ab(WINDOW, batched_api=True)
+    for la, lb in zip(jax.tree.leaves(state_1), jax.tree.leaves(state_w)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+            "serving parity: the batched front door diverged from the "
+            "per-request (window-1) path on the same trace")
+    ab = {
+        "ticks": T_AB, "jobs": n_ab,
+        "per_request_wall_s": round(wall_1, 3),
+        "batched_wall_s": round(wall_w, 3),
+        "per_request_jobs_per_sec": round(n_ab / max(wall_1, 1e-9), 1),
+        "batched_jobs_per_sec": round(n_ab / max(wall_w, 1e-9), 1),
+        "speedup": round(wall_1 / max(wall_w, 1e-9), 2),
+        "bit_identical": True,
+    }
+    assert wall_w < wall_1, (
+        f"serving parity A/B: batched (window={WINDOW}) wall {wall_w:.3f}s "
+        f"did not beat the per-request wall {wall_1:.3f}s")
+
+    # ---------------- shared wall-clock client machinery ----------------
+    def run_clients(s, n_jobs, n_clients, batch, offered_rate=None,
+                    sample=None):
+        per = n_jobs // n_clients
+        counters = {"retries": 0, "rejected": 0}
+        lock = threading.Lock()
+
+        def client(ci):
+            crng = np.random.default_rng(1000 + ci)
+            gap = (batch / (offered_rate / n_clients)
+                   if offered_rate else None)
+            nxt = _time.time()
+            batch_rows = []
+            for i in range(per):
+                c = int(crng.integers(0, C))
+                # durations 1-2.5 virtual s: long enough to span a
+                # coalesce window (latency attribution sees them run),
+                # short enough that the running set stays shallow and the
+                # queue-admission budget refills at full rate
+                batch_rows.append(
+                    {**job_to_json(ci * per + i + 1,
+                                   int(crng.integers(1, 4)),
+                                   int(crng.integers(100, 2000)),
+                                   int(crng.integers(1000, 2501))),
+                     "Cluster": c})
+                if len(batch_rows) < batch and i != per - 1:
+                    continue
+                if gap is not None:
+                    nxt += gap
+                    delay = nxt - _time.time()
+                    if delay > 0:
+                        _time.sleep(delay)
+                while True:
+                    code, body = httpd.post_json(s.url + "/submitBatch",
+                                                 batch_rows)
+                    if code == 200:
+                        break
+                    assert code == 503, f"submit -> {code}"
+                    e = json.loads(body)
+                    with lock:
+                        counters["retries"] += 1
+                        counters["rejected"] += len(e["RejectedIdx"])
+                    batch_rows = [batch_rows[k] for k in e["RejectedIdx"]]
+                    _time.sleep(e["RetryAfterMs"] / 1000.0)
+                batch_rows = []
+
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+        t0 = _time.time()
+        for th in ths:
+            th.start()
+        ages = []
+        while any(th.is_alive() for th in ths):
+            if sample is not None:
+                code, body = httpd.get(s.url + sample)
+                if code == 200:
+                    ages.append(json.loads(body)["snapshot_age_ms"])
+            _time.sleep(0.05)
+        for th in ths:
+            th.join()
+        submit_wall = _time.time() - t0
+        total = per * n_clients
+        deadline = _time.time() + (120 if quick else 600)
+        while _time.time() < deadline:
+            st_ = s.snapshot
+            if st_.placed >= total and st_.staged_jobs == 0:
+                break
+            _time.sleep(0.02)
+        return (_time.time() - t0, submit_wall, total, counters, ages)
+
+    # ---------------- phase 2: throughput under concurrent load --------
+    # best-of-2 fresh runs, the repo's standard timing methodology
+    # (_engine_run reports min-of-N walls for the same reason): the 1-core
+    # host shares every cycle between clients, HTTP threads, and the
+    # dispatcher, so run-to-run spread is real — both rates land in the
+    # detail, the better one is the recorded measure
+    N_T = 6_000 if quick else 60_000
+    t_runs = []
+    for _rep in range(1 if quick else 2):
+        s_t = ServingScheduler("serve-tput", specs, mkcfg(), speed=100.0,
+                               window=WINDOW, pacer=True, warm_k=K_WARM,
+                               k_cap=128, max_staged=10 ** 6)
+        s_t.start()
+        wall_t, submit_t, total_t, ctr_t, ages_t = run_clients(
+            s_t, N_T, n_clients=4, batch=128, sample="/stats")
+        # shutdown joins the drive thread BEFORE the host reads the
+        # state: a concurrent donating dispatch would invalidate the
+        # buffers under the reader
+        s_t.shutdown()
+        drops_t = assert_clean(s_t, "throughput", total_t)
+        t_runs.append((total_t / max(wall_t, 1e-9), wall_t, submit_t,
+                       total_t, ctr_t, ages_t, s_t))
+    rate_t, wall_t, submit_t, total_t, ctr_t, ages_t, s_t = max(
+        t_runs, key=lambda r: r[0])
+    prov = s_t.provenance()
+
+    # ---------------- phase 3: latency at a paced offered rate ---------
+    N_L = 2_000 if quick else 16_000
+    s_l = ServingScheduler("serve-lat", specs, mkcfg(trace_events=2048),
+                           speed=100.0, window=WINDOW, pacer=True,
+                           warm_k=K_WARM, k_cap=128, max_staged=10 ** 6,
+                           track_latency=True)
+    s_l.start()
+    # ~30% of the trace-off measure: record_trace roughly triples the
+    # per-tick cost (the [C, E] trace buffers rewrite per tick), and a
+    # latency phase offered near trace-on saturation measures queueing
+    # blowup, not the serving pipeline
+    offered = max(rate_t * 0.3, 500.0)
+    wall_l, submit_l, total_l, ctr_l, ages_l = run_clients(
+        s_l, N_L, n_clients=2, batch=64, offered_rate=offered,
+        sample="/quote?cluster=0")
+    s_l.shutdown()  # join the drive thread before reading the state
+    assert_clean(s_l, "latency", total_l)
+    lat = s_l.latencies_ms()
+    assert len(lat) >= 0.95 * total_l, (
+        f"latency accounting covered only {len(lat)}/{total_l} jobs")
+    lat_detail = {
+        "offered_jobs_per_sec": round(offered, 1),
+        "achieved_jobs_per_sec": round(total_l / max(wall_l, 1e-9), 1),
+        "jobs": total_l,
+        "p50_ms": round(float(np.percentile(lat, 50)), 1),
+        "p99_ms": round(float(np.percentile(lat, 99)), 1),
+        "max_ms": round(float(np.max(lat)), 1),
+    }
+
+    assert rate_t > ab["per_request_jobs_per_sec"], (
+        f"serving: batched throughput {rate_t:.0f} jobs/s did not beat "
+        f"the per-request path's {ab['per_request_jobs_per_sec']} jobs/s")
+    if not quick:
+        # the acceptance bar: two orders of magnitude over the recorded
+        # live per-request constellation (113 jobs/s, BENCH `live`)
+        assert rate_t >= 10_000, (
+            f"serving throughput {rate_t:.0f} jobs/s under the 10k bar")
+
+    detail = {
+        "clusters": C, "backend": jax.default_backend(),
+        "parity_ab": ab,
+        "throughput": {
+            "jobs": total_t, "wall_s": round(wall_t, 3),
+            "submit_wall_s": round(submit_t, 3),
+            "jobs_per_sec": round(rate_t, 1),
+            "rates": [round(r[0], 1) for r in t_runs],
+            "timing": f"best-of-{len(t_runs)}",
+            "clients": 4, "client_batch": 128,
+            "retries_503": ctr_t["retries"],
+            "rejected_jobs_quoted": ctr_t["rejected"],
+            "drops": drops_t,
+        },
+        "latency": lat_detail,
+        "snapshot_age_at_query_ms": {
+            "p50": round(float(np.percentile(ages_t + ages_l, 50)), 2),
+            "max": round(float(np.max(ages_t + ages_l)), 2),
+        } if (ages_t or ages_l) else None,
+        # serving provenance (PR 6 joinability contract): policy + the
+        # coalesce shape the run actually saw
+        **{k: prov[k] for k in ("policy", "coalesce_window_ticks", "k_cap",
+                                "snapshot_every", "batch_jobs", "ragged_k",
+                                "dispatches", "ticks_dispatched")},
+        "note": ("end-to-end over real localhost HTTP: concurrent client "
+                 "batches -> staged ticks -> ONE run_io dispatch per "
+                 "coalesce window, donated device state, snapshot-backed "
+                 "queries; vs BENCH `live` per-request baseline 113 "
+                 "jobs/s"),
+    }
+    return {
+        "metric": "serving_front_door_jobs_per_sec",
+        "value": round(rate_t, 1),
+        "unit": "jobs/s",
+        "vs_baseline": round(rate_t / (1_000_000 / 60.0), 3),
+        "detail": detail,
+    }
+
+
 def bench_scale16k(quick=False):
     """Headroom demonstration: 4x the north star — 4M jobs x 16,384
     clusters, the exact headline setup at 4x the cluster count (~24 s
@@ -1669,6 +2020,7 @@ CONFIGS = {
     "borg_replay": bench_borg_replay,
     "sparse_bursts": bench_sparse_bursts,
     "live": bench_live,
+    "serving": bench_serving,
     "tournament": bench_tournament,
     "env": bench_env,
     "multichip": bench_multichip,
@@ -1693,9 +2045,11 @@ def _setup_jax(cache_dir=None, cache_enabled=True):
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    if os.environ.get("MCS_LIVE_CHILD") == "1":
+    if (os.environ.get("MCS_LIVE_CHILD") == "1"
+            or os.environ.get("MCS_SERVING_CHILD") == "1"):
         # the axon sitecustomize re-pins the TPU platform at interpreter
-        # startup regardless of env; force the live child onto host CPU
+        # startup regardless of env; force the live/serving child onto
+        # host CPU
         jax.config.update("jax_platforms", "cpu")
 
 
@@ -1706,6 +2060,12 @@ def main():
                     help="shorthand for --config tournament: one compiled "
                          "policy-tournament over the scheduler zoo "
                          "(tools/tournament.py)")
+    ap.add_argument("--serving", action="store_true",
+                    help="shorthand for --config serving: the batched "
+                         "front door (services/serving.py) — concurrent "
+                         "HTTP clients, coalesced run_io dispatch, "
+                         "per-request parity A/B, p50/p99 submit-to-"
+                         "placed latency")
     ap.add_argument("--env-bench", action="store_true",
                     help="shorthand for --config env: batched RL-environment "
                          "stepping (envs/) — envs·steps/sec with auto-reset, "
@@ -1760,6 +2120,8 @@ def main():
     args = ap.parse_args()
     if args.tournament:
         args.config = "tournament"
+    if args.serving:
+        args.config = "serving"
     if args.env_bench:
         args.config = "env"
     if args.multichip:
@@ -1819,14 +2181,14 @@ def main():
 
         _PIPELINE["mode"] = "on" if args.pipeline == "ab" else args.pipeline
         res = call()
-        if args.pipeline == "ab" and name not in ("parity_tpu", "live", "tournament", "env", "multichip"):
+        if args.pipeline == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip"):
             ab_compare(res, _PIPELINE, "on", "pipeline_ab",
                        "pipelined", "unpipelined")
-        if args.time_compress == "ab" and name not in ("parity_tpu", "live", "tournament", "env", "multichip"):
+        if args.time_compress == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip"):
             ab_compare(res, _TIME_COMPRESS, "auto", "time_compress_ab",
                        "compressed", "dense",
                        extra=("ticks_executed", "ticks_simulated"))
-        if args.compact == "ab" and name not in ("parity_tpu", "live", "tournament", "env", "multichip"):
+        if args.compact == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip"):
 
             def compact_gates(d, doff, ab):
                 # correctness gate, not just walls: the wide re-run must
@@ -1890,7 +2252,8 @@ def main():
         # re-enters main() in a subprocess: its partial single-config view
         # would transiently clobber the record the parent is about to merge
         # into (ADVICE r5)
-        if os.environ.get("MCS_LIVE_CHILD") != "1":
+        if (os.environ.get("MCS_LIVE_CHILD") != "1"
+                and os.environ.get("MCS_SERVING_CHILD") != "1"):
             try:
                 with open(results_path) as f:
                     results = json.load(f)

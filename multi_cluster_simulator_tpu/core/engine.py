@@ -819,6 +819,41 @@ class Engine:
         state, series = jax.lax.scan(body, state, None, length=n_ticks)
         return (state, series) if record else state
 
+    def run_io(self, state: SimState, rows: jax.Array, counts: jax.Array,
+               params=None):
+        """Multi-tick ``tick_io``: advance one staged TickArrivals chunk
+        (``rows [T, C, K, NF]`` / ``counts [T, C]``) in a single device
+        dispatch, emitting the host-visible ``TickIO`` events of every tick
+        stacked over the leading axis. This is the serving tier's dispatch
+        unit (services/serving.py): a live host coalesces N concurrent
+        request arrivals into one chunk and pays ONE dispatch for T ticks
+        instead of one ``tick_io`` round trip per tick — the per-request
+        path's dominant cost (~5 ms host overhead per tick, BENCH `live`).
+
+        Chunk composition is exact: scanning T ticks here is the same
+        function composition as T single-tick calls, so a window-1 driver
+        and a window-W driver over the same staged stream are bit-identical
+        (tests/test_pipeline.py pins run_io == run_jit over the same
+        bucket). T and K are shape parameters — serving hosts keep T fixed
+        at the coalesce window and pow2-bucket K so compile count stays
+        bounded at log2(max K) (the pack_arrivals_chunks discipline)."""
+
+        def body(s, x):
+            r, c = x
+            s2, io = self._tick(s, (r, c), emit_io=True, tick_indexed=True,
+                                params=params)
+            return s2, io
+
+        return jax.lax.scan(body, state, (rows, counts))
+
+    def run_io_jit(self, donate: bool = False):
+        """A jitted ``run_io`` (same donation contract as ``run_jit``):
+        (state, rows, counts) -> (state, TickIO stacked over T). One
+        executable per (T, K) shape pair — serving drivers hold T fixed
+        and bucket K."""
+        return jax.jit(self.run_io,
+                       donate_argnums=(0,) if donate else ())
+
     def run_jit(self, donate: bool = False):
         """A jitted ``run``: (state, arrivals, n_ticks-static) -> state, or
         (state, MetricSample series) when cfg.record_metrics is set.

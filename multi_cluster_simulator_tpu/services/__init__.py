@@ -21,6 +21,10 @@ Modules:
   workload       — the workload-generator client service (pkg/client)
   rpc        — gRPC bindings over the proto messages (pkg/trader/gen)
   main       — entry points (cmd/*)
+  serving    — the batched front door (scheduling-as-a-service): staged
+               concurrent submits coalesced into one multi-tick device
+               dispatch per window, snapshot-backed queries, explicit
+               503 back-pressure (ARCHITECTURE.md §serving tier)
 """
 
 from multi_cluster_simulator_tpu.services.registry import (  # noqa: F401

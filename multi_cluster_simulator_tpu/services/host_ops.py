@@ -87,6 +87,44 @@ def push_l0(state: SimState, job_vec) -> SimState:
         drops=state.drops.replace(queue=state.drops.queue.at[0].add(dropped)))
 
 
+def _cat(tree, c):
+    """View of cluster ``c`` (traced index — the serving tier hosts the
+    whole constellation in one state, unlike the C=1 live hosts)."""
+    return jax.tree.map(lambda a: a[c], tree)
+
+
+def _putat(tree, sub, c):
+    return jax.tree.map(lambda a, b: a.at[c].set(b), tree, sub)
+
+
+@jax.jit
+def push_ready_at(state: SimState, job_vec, c) -> SimState:
+    """``push_ready`` for cluster ``c`` of a multi-cluster serving state
+    (services/serving.py parks mismatched-endpoint jobs here — the
+    endpoint-faithful routing the C=1 live host does via ``push_ready``)."""
+    ready_c = _cat(state.ready, c)
+    dropped = Q.push_back_dropped(ready_c, jnp.ones((), bool))
+    ready_c = Q.push_back(ready_c, Q.JobRec(vec=job_vec), jnp.ones((), bool))
+    return state.replace(
+        ready=_putat(state.ready, ready_c, c),
+        drops=state.drops.replace(queue=state.drops.queue.at[c].add(dropped)))
+
+
+@jax.jit
+def push_l0_at(state: SimState, job_vec, c) -> SimState:
+    """``push_l0`` for cluster ``c`` of a multi-cluster serving state —
+    Level0 append + wait-timer start + jobs_in_queue increment, exactly
+    the C=1 ``push_l0`` semantics at a traced cluster index."""
+    l0_c = _cat(state.l0, c)
+    dropped = Q.push_back_dropped(l0_c, jnp.ones((), bool))
+    l0_c = Q.push_back(l0_c, Q.JobRec(vec=job_vec), jnp.ones((), bool))
+    return state.replace(
+        l0=_putat(state.l0, l0_c, c),
+        wait_jobs=state.wait_jobs.at[c].add(1 - dropped),
+        jobs_in_queue=state.jobs_in_queue.at[c].add(1 - dropped),
+        drops=state.drops.replace(queue=state.drops.queue.at[c].add(dropped)))
+
+
 @jax.jit
 def remove_borrowed(state: SimState, job_vec) -> SimState:
     """The /lent handler (server.go:115-137): a returned finished job is

@@ -49,7 +49,7 @@ class RoutedHTTPServer:
             protocol_version = "HTTP/1.1"
 
             def _dispatch(self, method: str):
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 fn = outer.routes.get((method, path))
                 if fn is None:
                     self.send_response(404)
@@ -59,6 +59,12 @@ class RoutedHTTPServer:
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n) if n else b""
                 headers = dict(self.headers)
+                if query:
+                    # query strings reach handlers through a synthetic
+                    # header — the Route signature stays (body, headers)
+                    # for every existing wire-parity handler (the serving
+                    # tier's GET /quote?cluster=N reads it)
+                    headers["X-MCS-Query"] = query
                 try:
                     if outer.tracer is not None:
                         parent = headers.get(telemetry.TRACE_HEADER)

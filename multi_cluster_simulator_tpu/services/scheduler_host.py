@@ -106,7 +106,15 @@ class SchedulerService(Service):
         # the tick thread drains it (so an in-flight compile or device step
         # never blocks the HTTP surface)
         self._pending: list[tuple] = []
-        self._plock = threading.Lock()  # guards: _pending
+        # staged-but-not-consumed jobs (pending + unconsumed ring rows):
+        # the submit handlers' back-pressure bound. Kept <= max_arrivals,
+        # which makes the drain-time ring-full drop structurally
+        # unreachable — a full ring answers 503 at submit time (with the
+        # client still holding the job) instead of 200-then-silent-drop.
+        # Conservative between ticks (the device may have consumed more
+        # than the last recount saw); _drain_pending recomputes it.
+        self._staged_n = 0
+        self._plock = threading.Lock()  # guards: _pending, _staged_n
         # mutation journal: a list while a tick's device call is in flight
         # (handlers' state ops are replayed onto the tick result at swap
         # time — see _mutate/_tick_once), None otherwise
@@ -153,8 +161,10 @@ class SchedulerService(Service):
             self._owner_idx = {u: i for i, u
                                in enumerate(self._owner_urls) if i}
             # acknowledged-but-not-ingested jobs re-stage for the first
-            # tick (they re-arrive at the restored clock)
+            # tick (they re-arrive at the restored clock); the submit
+            # bound counts them from the start
             self._pending.extend(tuple(p) for p in extra.get("pending", []))
+            self._staged_n = len(self._pending)
         self.logger.info(
             "restored checkpoint %s (t=%d ms, %d running, %d queued)",
             self.checkpoint_path, int(np.asarray(self.state.t)),
@@ -176,7 +186,9 @@ class SchedulerService(Service):
     def _handle_submit_fifo(self, body: bytes, headers: dict):
         """POST / — submit to the ReadyQueue (server.go:23-51) *regardless
         of the configured algorithm*, exactly as the reference's handler
-        does; echoes a GET <Referer>/jobAdded acknowledgement."""
+        does; echoes a GET <Referer>/jobAdded acknowledgement. A full
+        staging ring answers a retryable 503 (the job was NOT accepted)
+        instead of the old 200-then-silent-drop."""
         try:
             job = job_from_json(json.loads(body))
         except ValueError:
@@ -184,7 +196,8 @@ class SchedulerService(Service):
         # manual job-receipt span nested under the middleware's server span
         # (the reference opens one at the top of the handler, server.go:24)
         with self.tracer.start_span("receive_job", job_id=job[0]):
-            self._stage_arrival(job, delay=False)
+            if not self._stage_arrival(job, delay=False):
+                return 503, self._ring_full_quote()
         referer = headers.get("Referer")
         if referer:
             self._pool.submit(httpd.get, referer.rstrip("/") + "/jobAdded")
@@ -195,15 +208,26 @@ class SchedulerService(Service):
         (server.go:53-78), again endpoint-routed, not policy-routed. The
         device ingest phase starts the wait timer and the on-state
         jobs_in_queue counter; the meter here mirrors the handler-side OTel
-        counter (server.go:75-76)."""
+        counter (server.go:75-76). 503 + quote when the staging ring is
+        full, like POST /."""
         try:
             job = job_from_json(json.loads(body))
         except ValueError:
             return 400, None
         with self.tracer.start_span("receive_job", job_id=job[0]):
-            self._stage_arrival(job, delay=True)
+            if not self._stage_arrival(job, delay=True):
+                return 503, self._ring_full_quote()
         self.meter.add("jobs_in_queue", 1)
         return 200, None
+
+    def _ring_full_quote(self) -> bytes:
+        """Machine-readable retry quote for a back-pressured submit: the
+        ring turns over as the tick loop drains it, so one tick period is
+        the natural retry horizon."""
+        return json.dumps({
+            "Error": "arrival ring full — retry",
+            "RetryAfterMs": round(self.cfg.tick_ms / self.speed, 3),
+        }).encode()
 
     def _mutate(self, op, replay=None):
         """Apply a state op (state -> (state', aux)) under the lock and
@@ -277,10 +301,26 @@ class SchedulerService(Service):
     # ------------------------------------------------------------------
     # arrival staging (the tensor form of the submit handlers)
     # ------------------------------------------------------------------
-    def _stage_arrival(self, job, delay: bool) -> None:
+    def _stage_arrival(self, job, delay: bool) -> bool:
+        """Stage a submitted job for the tick thread. Returns False —
+        nothing staged — when the ring bound is reached: the handler
+        answers 503 and the telemetry counts the rejection, so a full ring
+        is the CLIENT's signal to retry, never a silent drop at drain
+        time."""
         jid, cores, mem, dur_ms, _ = job
         with self._plock:
-            self._pending.append((jid, cores, mem, dur_ms, delay))
+            if self._staged_n >= self.cfg.max_arrivals:
+                rejected = True
+            else:
+                rejected = False
+                self._staged_n += 1
+                self._pending.append((jid, cores, mem, dur_ms, delay))
+        if rejected:
+            self.meter.add("submit_rejected", 1)
+            self.logger.warning(
+                "arrival ring full; rejecting job %d with 503", jid)
+            return False
+        return True
 
     def _drain_pending(self) -> None:  # holds: _slock
         """Move submitted jobs into the engine, timestamped at the current
@@ -295,6 +335,7 @@ class SchedulerService(Service):
         with self._plock:
             pending, self._pending = self._pending, []
         if not pending:
+            self._recount_staged()
             return
         now = int(np.asarray(self.state.t))
         delay_policy = self.cfg.policy is not PolicyKind.FIFO
@@ -308,7 +349,16 @@ class SchedulerService(Service):
             if self._arr_n == self.cfg.max_arrivals:
                 self._compact_arrivals()
             if self._arr_n == self.cfg.max_arrivals:
-                self.logger.error("arrival ring full; dropping job %d", jid)
+                # structurally unreachable since the submit bound
+                # (_stage_arrival keeps staged <= max_arrivals, and the
+                # compaction above removes every consumed row) — but if a
+                # future edit breaks that invariant, COUNT the loss so no
+                # acknowledged job ever vanishes silently
+                self.logger.error(
+                    "arrival ring full at drain; dropping acked job %d "
+                    "(staging bound violated?)", jid)
+                self.state = self.state.replace(drops=self.state.drops.replace(
+                    queue=self.state.drops.queue.at[0].add(1)))
                 continue
             i = self._arr_n
             self._arr["t"][0, i] = now
@@ -317,6 +367,15 @@ class SchedulerService(Service):
             self._arr["mem"][0, i] = mem
             self._arr["dur"][0, i] = dur_ms
             self._arr_n += 1
+        self._recount_staged()
+
+    def _recount_staged(self) -> None:  # holds: _slock
+        """Re-anchor the submit-path back-pressure counter to ground
+        truth: unconsumed ring rows (the device cursor advanced since the
+        last drain) plus whatever landed in _pending meanwhile."""
+        consumed = int(np.asarray(self.state.arr_ptr)[0])
+        with self._plock:
+            self._staged_n = (self._arr_n - consumed) + len(self._pending)
 
     def _compact_arrivals(self) -> None:  # holds: _slock
         """Drop the consumed prefix of the ring and rebase the device

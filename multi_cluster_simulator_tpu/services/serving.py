@@ -1,0 +1,792 @@
+"""Scheduling-as-a-service: the async batched front door (serving tier).
+
+The per-request live path (services/scheduler_host.py, BENCH ``live``) pays
+~5 ms of host cost per tick because every HTTP arrival walks the service
+stack one job at a time and every tick is its own ``tick_io`` device round
+trip — 113 jobs/s against the batch engine's 406k (ROADMAP item 4). This
+module closes that gap the way Blox argues schedulers should be built
+(arxiv 2312.12621: modular services over a shared batched core): the HTTP
+handlers only STAGE — parse JSON, stamp the arrival with the current
+virtual tick, append to a bounded per-tick bucket — and a single drive
+thread coalesces everything staged across ticks and clusters into the same
+ragged ``TickArrivals`` chunk format the streamed bench pipeline ingests
+(``engine.pack_arrivals_chunks`` discipline: rows padded to the chunk's own
+pow2-bucketed K), then advances the device-resident, donated ``SimState``
+with ONE ``Engine.run_io`` dispatch per coalesce window — N requests cost
+one dispatch, not N.
+
+Three contracts, each load-bearing:
+
+- **Handlers never touch the device.** Submit handlers stage host tuples;
+  read handlers (``/stats``, ``/quote``, ``/placed``) answer from the
+  latest immutable ``Snapshot`` — a host-side numpy view the drive thread
+  refreshes off the tick loop after a dispatch. No handler ever
+  synchronizes the hot path (simlint rule ``serve-sync`` enforces this
+  statically; LINTING.md family 8). Every query response carries
+  ``snapshot_age_ms`` so clients know the consistency window.
+
+- **Back-pressure is explicit.** Staging is bounded (``max_staged`` total,
+  ``k_cap`` per (tick, cluster) — the latter also bounds the compiled K
+  bucket); a full ring answers 503 with a machine-readable retry quote
+  (``RetryAfterMs``, queue depth, snapshot age) and a
+  ``submit_rejected`` telemetry count — never a silent drop. The engine's
+  own drop counters stay asserted zero by the bench.
+
+- **Coalescing is invisible to placement.** Dispatch is ``Engine.run_io``
+  — a scan of the same tick body a window-1 driver would run — so a
+  window-W front door is bit-identical to the per-request path over the
+  same staged stream, and both are bit-identical to the batch engine over
+  the equivalent bucketed Arrivals (tests/test_services.py pins all
+  three; bench.py --serving asserts the A/B parity on every run).
+
+Wire parity: ``POST /`` and ``POST /delay`` accept the reference's Go Job
+JSON (an optional ``Cluster`` field routes among the hosted clusters;
+endpoint routing follows the reference — a mismatched-endpoint job is
+pushed into the queue the policy never drains, exactly as in Go).
+``POST /submitBatch`` is the front door's native client API: a JSON array
+of the same Job objects, one HTTP round trip for a client-side buffer.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+from multi_cluster_simulator_tpu.core import state as st
+from multi_cluster_simulator_tpu.core.engine import Engine, round_up_pow2
+from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.ops import fields as F
+from multi_cluster_simulator_tpu.ops import queues as Q
+from multi_cluster_simulator_tpu.services import host_ops
+from multi_cluster_simulator_tpu.services.lifecycle import Service
+from multi_cluster_simulator_tpu.services.registry import SERVICE_SCHEDULER
+from multi_cluster_simulator_tpu.services.scheduler_host import job_from_json
+
+_OWNER = int(np.asarray(Q.OWN))
+
+
+def make_row(jid: int, cores: int, mem: int, gpu: int, dur_ms: int,
+             enq_t: int) -> tuple:
+    """One staged job as a queue row in the canonical field order
+    (ops/fields.QUEUE_FIELDS) — the same row ``pack_arrivals_chunks``
+    builds, so staged buckets and stream buckets are interchangeable."""
+    return (int(jid), int(cores), int(mem), int(gpu), int(dur_ms),
+            int(enq_t), _OWNER, 0,
+            int(F.job_class(int(cores), int(gpu))))
+
+
+class Snapshot:
+    """One immutable host-readable view of the device state, refreshed by
+    the drive thread after a dispatch — the query side-channel's source of
+    truth. Handlers read the latest snapshot by reference (one atomic
+    attribute load); the device hot path is never synchronized on a
+    request's behalf."""
+
+    __slots__ = ("wall", "sim_t", "stage_t", "placed_total", "placed",
+                 "jobs_in_queue", "queue_depth", "running", "avg_wait_ms",
+                 "drops", "queue_ids", "run_ids", "run_active",
+                 "dispatches", "staged_jobs")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+    def age_ms(self) -> float:
+        return (time.time() - self.wall) * 1000.0
+
+    def job_status(self, cluster: int, jid: int) -> str:
+        """queued | running | unknown — a placement lookup over the
+        snapshot's id columns (host numpy, no device access). ``unknown``
+        covers both never-seen and already-completed ids; the submit log
+        (when latency tracking is on) disambiguates bench-side."""
+        for ids in self.queue_ids:
+            if (ids[cluster] == jid).any():
+                return "queued"
+        hit = self.run_ids[cluster] == jid
+        if (hit & self.run_active[cluster]).any():
+            return "running"
+        return "unknown"
+
+
+class ServingScheduler(Service):
+    """The batched front door: one service hosts the WHOLE constellation
+    (a [C]-cluster ``SimState`` resident on device) behind concurrent
+    HTTP submit endpoints and snapshot-backed query endpoints.
+
+    ``window`` is the coalesce window in ticks: the drive thread seals one
+    staging bucket per virtual tick (``speed`` virtual seconds per wall
+    second, the live host's pacing contract) and dispatches every
+    ``window`` sealed ticks as one ``Engine.run_io`` call with donated
+    state. ``pacer=False`` disables the drive thread for deterministic
+    drivers (tests, the bench's parity A/B): the caller paces staging with
+    ``seal_tick()`` and dispatches with ``dispatch_sealed()`` — a
+    window-1 caller IS the per-request cost model, and both compose to
+    bit-identical states.
+
+    ``snapshot_every`` trades freshness for dispatch-pipeline depth: the
+    drive thread refreshes the query snapshot (the only host
+    synchronization in the loop) every N dispatches.
+    """
+
+    service_name = SERVICE_SCHEDULER
+    required_services: list = []
+
+    def __init__(self, name: str, specs, cfg: SimConfig,
+                 registry_url: Optional[str] = None, speed: float = 1.0,
+                 window: int = 16, k_cap: int = 128,
+                 max_staged: Optional[int] = None, pacer: bool = True,
+                 snapshot_every: int = 1, track_latency: bool = False,
+                 warm_k=(1,), **kw):
+        super().__init__(name, registry_url=registry_url, speed=speed, **kw)
+        self.specs = list(specs)
+        self.cfg = cfg
+        self.window = int(window)
+        self.k_cap = int(k_cap)
+        self.C = len(self.specs)
+        self.max_staged = (int(max_staged) if max_staged is not None
+                           else 4 * self.window * self.C)
+        self.pacer = pacer
+        self.snapshot_every = max(int(snapshot_every), 1)
+        self.track_latency = track_latency
+        self.warm_k = tuple(warm_k)
+        self._warm_sorted = tuple(sorted(set(int(k) for k in warm_k)))
+        self.engine = Engine(cfg)
+        # the device state has ONE owner — the drive thread (or the
+        # deterministic driver): handlers never read or write it, so no
+        # state lock exists by construction. Leaves are cloned once so
+        # every buffer is unique — init_state shares zero-filled buffers
+        # across leaves, which a donating dispatch may not receive twice
+        import jax.numpy as jnp
+        self._state = jax.tree.map(jnp.copy, init_state(cfg, self.specs))
+        self._run_io = self.engine.run_io_jit(donate=True)
+        self._delay_policy = cfg.policy is not PolicyKind.FIFO
+        # staging: one open bucket per cluster for the current tick, a
+        # FIFO of sealed per-tick buckets awaiting dispatch, and the
+        # parked mismatched-endpoint jobs (applied at dispatch time)
+        self._stage_lock = threading.Lock()  # guards: _open, _sealed, _stage_t, _staged_jobs, _parked, _rejected, _submit_wall, _unseen
+        self._open: list[list[tuple]] = [[] for _ in range(self.C)]
+        self._sealed: list[list[list[tuple]]] = []
+        self._stage_t = 0  # ticks staged (== index of the open tick)
+        self._staged_jobs = 0  # staged, not yet dispatched (back-pressure)
+        # per-cluster jobs admitted but not yet visible in a snapshot's
+        # queue depth (staged OR dispatched-since-last-refresh): the
+        # admission bound snap.queue_depth[c] + _unseen[c] <= queue_capacity
+        # makes a device queue-overflow drop impossible by construction —
+        # saturation surfaces as a 503 quote, never a silent drop
+        self._unseen = np.zeros(self.C, np.int64)
+        self._parked: list[tuple] = []  # (c, row, to_delay)
+        self._rejected = 0
+        self._submit_wall: dict[tuple, float] = {}
+        self._inflight = np.zeros(self.C, np.int64)  # drive-thread-owned
+        # dispatch bookkeeping (drive/driver thread only — single owner,
+        # like the state): ticks dispatched, per-dispatch batch sizes, and
+        # the snapshot visibility log the latency accounting reads. A
+        # long-running service must not grow host memory per dispatch, so
+        # the per-dispatch series are BOUNDED: batch sizes keep running
+        # aggregates plus a recent window (for the p50), K values are a
+        # set (at most log2(k_cap) members), and the visibility log is a
+        # deque whose window comfortably covers any bench run (latency
+        # accounting is a bench/driver concern — _submit_wall only grows
+        # under track_latency, never in plain serving)
+        import collections
+        self.ticks_dispatched = 0
+        self.dispatches = 0
+        self.batch_jobs: collections.deque = collections.deque(maxlen=4096)
+        self._batch_n = 0
+        self._batch_sum = 0
+        self._batch_max = 0
+        self.chunk_k: set[int] = set()
+        self.visibility_log: collections.deque = collections.deque(
+            maxlen=1 << 16)  # (ticks_dispatched, wall)
+        self._snap: Optional[Snapshot] = None
+        self._stop = threading.Event()
+        self._drive_thread: Optional[threading.Thread] = None
+        self._pacer_thread: Optional[threading.Thread] = None
+        # one compiled probe for the whole snapshot's scalar/vector reads:
+        # the eager per-op form cost more than a full dispatch at serving
+        # shapes (each eager op is its own device round trip on CPU)
+        self._snap_probe = jax.jit(self._snap_probe_fn)
+        self._refresh_snapshot()
+
+    # ------------------------------------------------------------------
+    # HTTP surface
+    # ------------------------------------------------------------------
+    def register_handlers(self) -> None:
+        self.httpd.route("POST", "/", self._handle_submit_fifo)
+        self.httpd.route("POST", "/delay", self._handle_submit_delay)
+        self.httpd.route("POST", "/submitBatch", self._handle_submit_batch)
+        self.httpd.route("GET", "/stats", self._handle_stats)
+        self.httpd.route("GET", "/quote", self._handle_quote)
+        self.httpd.route("GET", "/placed", self._handle_placed)
+        self.httpd.route("GET", "/metrics",
+                         lambda b, h: (200, self.meter.render_prometheus().encode()))
+
+    def _handle_submit_fifo(self, body: bytes, headers: dict):
+        """POST / — the reference's ReadyQueue endpoint (server.go:23-51),
+        stage-only: no device work, no lock shared with the dispatch."""
+        return self._submit_one(body, delay=False)
+
+    def _handle_submit_delay(self, body: bytes, headers: dict):
+        """POST /delay — the reference's Level0 endpoint (server.go:53-78),
+        stage-only."""
+        return self._submit_one(body, delay=True)
+
+    def _submit_one(self, body: bytes, delay: bool):
+        try:
+            d = json.loads(body)
+            jid, cores, mem, dur_ms, _ = job_from_json(d)
+            c = int(d.get("Cluster", 0))
+            gpu = int(d.get("GpusNeeded", 0))
+        except (ValueError, TypeError):
+            return 400, None
+        if not (0 <= c < self.C):
+            return 400, json.dumps({"Error": f"no cluster {c}"}).encode()
+        rejected, reasons, accepted, depth = self._stage(
+            [(c, jid, cores, mem, gpu, dur_ms, delay)])
+        if rejected:
+            return 503, self._quote(rejected, reasons, accepted, depth)
+        self.meter.add("jobs_submitted", 1)
+        if delay:
+            self.meter.add("jobs_in_queue", 1)
+        return 200, None
+
+    def _handle_submit_batch(self, body: bytes, headers: dict):
+        """POST /submitBatch — the front door's native client API: a JSON
+        array of Go Job objects (optional ``Cluster`` per job; optional
+        ``Delay`` routes a job with the /delay endpoint's semantics
+        instead of the policy-matching default), admitted per job. A
+        partially back-pressured batch answers 503 naming the rejected
+        indices: the accepted prefix IS staged, and the client resubmits
+        only ``RejectedIdx`` after ``RetryAfterMs`` — no head-of-line
+        blocking by one saturated cluster."""
+        try:
+            arr = json.loads(body)
+            if isinstance(arr, dict):
+                arr = arr["Jobs"]
+            jobs = []
+            for d in arr:
+                jid, cores, mem, dur_ms, _ = job_from_json(d)
+                jobs.append((int(d.get("Cluster", 0)), jid, cores, mem,
+                             int(d.get("GpusNeeded", 0)), dur_ms,
+                             bool(d.get("Delay", self._delay_policy))))
+        except (ValueError, TypeError, KeyError):
+            return 400, None
+        if any(not (0 <= j[0] < self.C) for j in jobs):
+            return 400, json.dumps({"Error": "bad Cluster"}).encode()
+        rejected, reasons, accepted, depth = self._stage(jobs)
+        self.meter.add("jobs_submitted", accepted)
+        # the handler-side jobs_in_queue counter moves for every accepted
+        # delay-routed job, exactly as the equivalent POST /delay would
+        # (server.go:75-76) — the two wire paths expose one meter
+        rej = set(rejected)
+        n_delay = sum(1 for i, j in enumerate(jobs)
+                      if j[6] and i not in rej)
+        if n_delay:
+            self.meter.add("jobs_in_queue", n_delay)
+        if rejected:
+            return 503, self._quote(rejected, reasons, accepted, depth)
+        return 200, json.dumps({"Accepted": accepted}).encode()
+
+    def _handle_stats(self, body: bytes, headers: dict):
+        """GET /stats — constellation totals from the latest snapshot
+        (never the device)."""
+        s = self._snap
+        return 200, json.dumps({
+            "t_ms": s.sim_t, "stage_t_ticks": s.stage_t,
+            "placed_total": s.placed, "running": int(s.running.sum()),
+            "queue_depth": int(s.queue_depth.sum()),
+            "jobs_in_queue": int(s.jobs_in_queue.sum()),
+            "staged_jobs": s.staged_jobs, "dispatches": s.dispatches,
+            "drops": s.drops, "rejected_503": self._rejected_count(),
+            "snapshot_age_ms": round(s.age_ms(), 3)}).encode()
+
+    def _handle_quote(self, body: bytes, headers: dict):
+        """GET /quote?cluster=N — wait-time quote for a would-be submitter:
+        the snapshot's average wait plus one coalesce window of staging
+        latency. Pure snapshot arithmetic."""
+        c = self._query_int(headers, "cluster", 0)
+        if not (0 <= c < self.C):
+            return 400, None
+        s = self._snap
+        return 200, json.dumps({
+            "cluster": c,
+            "wait_quote_ms": round(float(s.avg_wait_ms[c])
+                                   + self._window_wall_ms(), 3),
+            "avg_wait_ms": round(float(s.avg_wait_ms[c]), 3),
+            "queue_depth": int(s.queue_depth[c]),
+            "snapshot_age_ms": round(s.age_ms(), 3)}).encode()
+
+    def _handle_placed(self, body: bytes, headers: dict):
+        """GET /placed?cluster=N&id=J — placement lookup over the snapshot
+        id columns."""
+        c = self._query_int(headers, "cluster", 0)
+        jid = self._query_int(headers, "id", -1)
+        if not (0 <= c < self.C):
+            return 400, None
+        s = self._snap
+        return 200, json.dumps({
+            "cluster": c, "id": jid, "status": s.job_status(c, jid),
+            "snapshot_age_ms": round(s.age_ms(), 3)}).encode()
+
+    @staticmethod
+    def _query_int(headers: dict, key: str, default: int) -> int:
+        from urllib.parse import parse_qs
+        q = parse_qs(headers.get("X-MCS-Query", ""))
+        try:
+            return int(q.get(key, [default])[0])
+        except (ValueError, TypeError):
+            return default
+
+    def _rejected_count(self) -> int:
+        with self._stage_lock:
+            return self._rejected
+
+    def _window_wall_ms(self) -> float:
+        return self.window * self.cfg.tick_ms / self.speed
+
+    # ------------------------------------------------------------------
+    # staging (the only submit-path work: host tuples under one lock)
+    # ------------------------------------------------------------------
+    def _retry_quote_ms(self) -> float:
+        """How long a back-pressured client should wait: admission budgets
+        refill at the snapshot-refresh cadence (one per dispatch, i.e. per
+        sealed window under load), so a quarter window is the expected
+        wait for fresh room without oversleeping past a refill."""
+        return min(max(self._window_wall_ms() / 4, 2.0), 200.0)
+
+    def _stage(self, jobs: list[tuple], ta: Optional[int] = None,
+               live_bounds: bool = True):
+        """Stage (cluster, id, cores, mem, gpu, dur_ms, delay) tuples onto
+        the open tick, admitting per job: a saturated cluster rejects its own
+        jobs without head-of-line-blocking the rest of the batch. Three
+        admission bounds, each surfacing as a quoted 503 (never a silent
+        drop):
+
+        - ``max_staged`` — total staging-ring room;
+        - ``queue`` — ``snapshot queue_depth[c] + unseen[c]`` admitted
+          against ``cfg.queue_capacity``, which makes a device queue-
+          overflow drop impossible by construction (every admitted job is
+          counted until a snapshot proves it left the queues);
+        - ``k_cap`` — the per-(tick, cluster) bucket bound (also the
+          compiled K ceiling).
+
+        ``ta`` overrides the arrival stamp (deterministic drivers feeding
+        a trace — it must bucket to the open tick, asserted);
+        ``live_bounds=False`` drops the queue-budget bound for those
+        drivers: they follow a fixed trace the caller has sized, assert
+        zero drops afterwards, and must not have live back-pressure
+        perturb trace-following (the HTTP handlers always keep it on).
+
+        Returns ``(rejected_indices, reasons, accepted, depth)``."""
+        now = time.time() if self.track_latency else 0.0
+        rejected: list[int] = []
+        reasons: set[str] = set()
+        with self._stage_lock:
+            # the snapshot must be read under the SAME lock hold as the
+            # unseen counters: _refresh_snapshot swaps the snapshot and
+            # decrements _unseen in one atomic step, so reading the
+            # snapshot before the lock could pair a STALE depth with the
+            # NEW unseen — inflating the budget by a whole dispatch's
+            # jobs and re-opening the silent-drop hole
+            snap = self._snap
+            room = self.max_staged - self._staged_jobs
+            budget: dict[int, int] = {}
+            tick = self.cfg.tick_ms
+            stamp = (self._stage_t + 1) * tick if ta is None else int(ta)
+            if ta is not None:
+                dest = max((stamp + tick - 1) // tick, 1) - 1
+                assert dest == self._stage_t, (
+                    f"ta={stamp} buckets to tick {dest}, open tick is "
+                    f"{self._stage_t} — pace seal_tick() to the stream")
+            for idx, (c, jid, cores, mem, gpu, dur, delay) in \
+                    enumerate(jobs):
+                if room <= 0:
+                    rejected.append(idx)
+                    reasons.add("max_staged")
+                    continue
+                if live_bounds:
+                    if c not in budget:
+                        budget[c] = (self.cfg.queue_capacity
+                                     - int(snap.queue_depth[c])
+                                     - int(self._unseen[c]))
+                    if budget[c] <= 0:
+                        rejected.append(idx)
+                        reasons.add("queue")
+                        continue
+                parked = delay != self._delay_policy
+                if not parked and len(self._open[c]) >= self.k_cap:
+                    rejected.append(idx)
+                    reasons.add("k_cap")
+                    continue
+                row = make_row(jid, cores, mem, gpu, dur, stamp)
+                if parked:
+                    # endpoint the policy never drains: pushed straight
+                    # into the ignored queue at dispatch time
+                    # (endpoint-faithful routing, server.go:22-78 — the
+                    # job sits forever)
+                    self._parked.append((c, row, delay))
+                else:
+                    self._open[c].append(row)
+                self._staged_jobs += 1
+                self._unseen[c] += 1
+                if live_bounds:
+                    budget[c] -= 1
+                room -= 1
+                if self.track_latency:
+                    self._submit_wall[(c, jid)] = now
+            if rejected:
+                self._rejected += len(rejected)
+            depth = int(snap.queue_depth.sum())
+        if rejected:
+            self.meter.add("submit_rejected", len(rejected))
+        return rejected, reasons, len(jobs) - len(rejected), depth
+
+    def _quote(self, rejected, reasons, accepted, depth) -> bytes:
+        return json.dumps({
+            "Error": f"staging ring full ({'+'.join(sorted(reasons))}) — "
+                     "retry",
+            "Accepted": accepted, "RejectedIdx": rejected,
+            "RetryAfterMs": round(self._retry_quote_ms(), 3),
+            "QueueDepth": depth,
+            "SnapshotAgeMs": round(self._snap.age_ms(), 3)}).encode()
+
+    def submit_direct(self, c: int, jid: int, cores: int, mem: int,
+                      dur_ms: int, gpu: int = 0, delay: Optional[bool] = None,
+                      ta: Optional[int] = None) -> bool:
+        """Driver-side staging without the HTTP hop (tests, fuzz drivers)
+        — one job through the same ``_stage`` core the handlers use, with
+        the queue-budget bound off (``live_bounds=False``): deterministic
+        drivers follow a fixed trace the caller has sized and assert zero
+        drops on the final state, so live back-pressure must not perturb
+        trace-following. ``ta`` overrides the arrival stamp — it must
+        bucket to the open tick exactly as ``pack_arrivals_chunks`` would
+        (asserted), so staged buckets stay interchangeable with stream
+        buckets."""
+        delay = self._delay_policy if delay is None else delay
+        rejected, _reasons, _acc, _depth = self._stage(
+            [(c, jid, cores, mem, gpu, dur_ms, delay)], ta=ta,
+            live_bounds=False)
+        return not rejected
+
+    def seal_tick(self) -> None:
+        """Close the open staging tick and start the next — the virtual
+        clock's staging edge. The drive thread calls this on the pacing
+        cadence; deterministic drivers call it directly."""
+        with self._stage_lock:
+            self._sealed.append(self._open)
+            self._open = [[] for _ in range(self.C)]
+            self._stage_t += 1
+
+    # ------------------------------------------------------------------
+    # dispatch (single owner: the drive thread or the deterministic driver)
+    # ------------------------------------------------------------------
+    def _sealed_count(self) -> int:
+        with self._stage_lock:
+            return len(self._sealed)
+
+    def _staged_ticks(self) -> int:
+        with self._stage_lock:
+            return self._stage_t
+
+    def _pick_k(self, need: int) -> int:
+        """K bucket for a chunk: the smallest WARMED bucket that fits
+        (padding wider than needed is semantically invisible — ingest
+        masks rows beyond each tick's count — and reusing a warmed
+        executable beats a mid-traffic XLA compile), else pow2 of the
+        need (one compile, then cached)."""
+        for k in self._warm_sorted:
+            if k >= need:
+                return k
+        return round_up_pow2(need)
+
+    def _pop_chunk(self, T: int):
+        with self._stage_lock:
+            ticks = self._sealed[:T]
+            del self._sealed[:T]
+            parked, self._parked = self._parked, []
+            n = sum(len(lst) for tk in ticks for lst in tk) + len(parked)
+            self._staged_jobs -= n
+        # dispatched jobs stay in _unseen (the admission bound's view of
+        # the device queues) until a snapshot shows them; _inflight is
+        # drive-thread-owned bookkeeping of that handoff
+        for tk in ticks:
+            for c, lst in enumerate(tk):
+                self._inflight[c] += len(lst)
+        for c, _row, _d in parked:
+            self._inflight[c] += 1
+        return ticks, parked, n
+
+    def _dispatch(self, T: int) -> int:
+        """Consume T sealed ticks as ONE device dispatch. Returns the
+        number of jobs dispatched."""
+        ticks, parked, n_jobs = self._pop_chunk(T)
+        # mismatched-endpoint jobs enter the queue their endpoint names
+        # (which the policy ignores — inert rows, so applying them at the
+        # chunk edge instead of mid-chunk is invisible to placement;
+        # PARITY.md §serving). One async jitted push per parked row: the
+        # dispatches queue without a host sync, and parked jobs exist
+        # only when a client posts to the endpoint the policy never
+        # drains — a client bug, not a traffic class worth a batched
+        # kernel; max_staged bounds the worst case
+        for c, row, delay in parked:
+            op = host_ops.push_l0_at if delay else host_ops.push_ready_at
+            self._state = op(self._state,
+                             np.asarray(row, np.int32), np.int32(c))
+        kmax = max((len(lst) for tk in ticks for lst in tk), default=0)
+        K = self._pick_k(max(kmax, 1))
+        rows = np.broadcast_to(np.asarray(Q._INVALID_ROW),
+                               (T, self.C, K, Q.NF)).copy()
+        counts = np.zeros((T, self.C), np.int32)
+        for ti, tk in enumerate(ticks):
+            for c, lst in enumerate(tk):
+                if lst:
+                    counts[ti, c] = len(lst)
+                    rows[ti, c, :len(lst)] = np.asarray(lst, np.int32)
+        self._state, io = self._run_io(self._state, rows, counts)
+        self.ticks_dispatched += T
+        self.dispatches += 1
+        self.batch_jobs.append(n_jobs)
+        self._batch_n += 1
+        self._batch_sum += n_jobs
+        self._batch_max = max(self._batch_max, n_jobs)
+        self.chunk_k.add(K)
+        if self.cfg.borrowing:
+            # host visibility of the cross-cluster events (the TickIO
+            # side-channel): counted into telemetry; the in-batch borrow
+            # phase already matched them on device
+            self.meter.add("borrow_requests",
+                           int(np.asarray(io.borrow_want).sum()))
+            self.meter.add("returns_emitted",
+                           int(np.asarray(io.ret_valid).sum()))
+        if self.dispatches % self.snapshot_every == 0:
+            self._refresh_snapshot()
+        return n_jobs
+
+    def dispatch_sealed(self) -> int:
+        """Dispatch every sealed tick: full coalesce windows first, then
+        the tail (deterministic drivers; the drive thread only ever
+        dispatches full windows). Returns jobs dispatched."""
+        n = 0
+        while self._sealed_count() >= self.window:
+            n += self._dispatch(self.window)
+        tail = self._sealed_count()
+        if tail:
+            n += self._dispatch(tail)
+        return n
+
+    _DROP_KEYS = ("queue", "msgs", "run_full", "vslot", "carve", "ingest")
+
+    @staticmethod
+    def _snap_probe_fn(s):
+        """The snapshot's derived reads as ONE jitted program (scalars and
+        [C] vectors; the id columns are raw leaves read directly)."""
+        import jax.numpy as jnp
+        qd = (s.l0.count + s.l1.count + s.ready.count + s.wait.count)
+        drops = jnp.stack([
+            jnp.sum(getattr(s.drops, k)).astype(jnp.int32) for k in
+            ServingScheduler._DROP_KEYS])
+        return (s.t, s.placed_total, s.jobs_in_queue, qd,
+                jnp.sum(s.run.active, axis=1), st.avg_wait_ms(s), drops)
+
+    def _refresh_snapshot(self) -> None:
+        """Build the next immutable query snapshot from the device state —
+        the ONE host synchronization in the serving loop, paid by the
+        drive thread off the request path. Also the latency visibility
+        edge: everything dispatched so far is host-visible once the swap
+        below lands, so the (ticks, wall) pair is appended after it."""
+        s = self._state
+        inflight, self._inflight = self._inflight, np.zeros(self.C, np.int64)
+        queues = (s.l0, s.l1, s.ready, s.wait)
+        t, placed_c, jq, qd, running, aw, dr = self._snap_probe(s)
+        # np.array, NOT np.asarray: on the CPU backend asarray returns a
+        # ZERO-COPY view into the device buffer, and the next donating
+        # dispatch hands that buffer back to XLA for reuse — a snapshot
+        # must own its memory or its readers see silently-recycled bytes
+        placed = np.array(placed_c)
+        depth = np.array(qd)
+        payload = dict(
+            wall=time.time(), sim_t=int(np.asarray(t)),
+            placed_total=placed, placed=int(placed.sum()),
+            jobs_in_queue=np.array(jq),
+            queue_depth=depth,
+            running=np.array(running),
+            avg_wait_ms=np.array(aw),
+            drops=dict(zip(self._DROP_KEYS,
+                           np.asarray(dr).tolist())),
+            queue_ids=[np.array(q.id) for q in queues],
+            run_ids=np.array(s.run.id),
+            run_active=np.array(s.run.active),
+            dispatches=self.dispatches)
+        with self._stage_lock:
+            # the unseen decrement and the snapshot swap are ONE atomic
+            # step: dispatched jobs leave the admission bound's unseen set
+            # only when the snapshot that shows their queue residency is
+            # the one _stage reads — decrementing before the swap would
+            # let a concurrent submit pair the NEW unseen with the STALE
+            # depth and over-admit into a full device queue (the silent-
+            # drop class this bound exists to exclude)
+            self._unseen -= inflight
+            self._snap = Snapshot(stage_t=self._stage_t,
+                                  staged_jobs=self._staged_jobs, **payload)
+        self.visibility_log.append((self.ticks_dispatched,
+                                    payload["wall"]))
+
+    @property
+    def snapshot(self) -> Snapshot:
+        return self._snap
+
+    def warmup(self, ks=None) -> None:
+        """Precompile the (window, K) dispatch executables on a throwaway
+        state clone so no live dispatch pays an XLA compile. K buckets are
+        pow2 (pack_arrivals_chunks discipline), so compile count is
+        bounded at log2(k_cap) even if traffic exceeds the warmed set."""
+        import jax.numpy as jnp
+        ks = self.warm_k if ks is None else ks
+        for K in ks:
+            rows = np.broadcast_to(
+                np.asarray(Q._INVALID_ROW),
+                (self.window, self.C, int(K), Q.NF)).copy()
+            counts = np.zeros((self.window, self.C), np.int32)
+            clone = jax.tree.map(jnp.copy, self._state)
+            out, _io = self._run_io(clone, rows, counts)
+            jax.block_until_ready(out.t)  # compile-only: clone discarded
+
+    # ------------------------------------------------------------------
+    # drive loop (wall-clock pacing)
+    # ------------------------------------------------------------------
+    def on_start(self) -> None:
+        self.warmup()
+        self._refresh_snapshot()
+        if self.pacer:
+            self._pacer_thread = threading.Thread(
+                target=self._pacer_loop, daemon=True,
+                name=f"{self.name}-pacer")
+            self._drive_thread = threading.Thread(
+                target=self._drive_loop, daemon=True,
+                name=f"{self.name}-drive")
+            self._pacer_thread.start()
+            self._drive_thread.start()
+
+    def on_shutdown(self) -> None:
+        self._stop.set()
+        if self._pacer_thread is not None:
+            self._pacer_thread.join(timeout=10)
+        if self._drive_thread is not None:
+            self._drive_thread.join(timeout=30)
+        if self.pacer:
+            # final flush AFTER both threads have exited: a flush inside
+            # the drive loop could race the still-running pacer and
+            # strand a tick sealed after the flush read the backlog —
+            # 200-acknowledged jobs silently lost at process exit. Here
+            # the caller thread owns the state (both owners joined), so
+            # every sealed tick is dispatched exactly once. Anything
+            # still OPEN was never sealed into virtual time and stays
+            # staged (documented).
+            self.dispatch_sealed()
+            self._refresh_snapshot()
+
+    def _pacer_loop(self) -> None:
+        """Seal staging ticks on the virtual-time cadence (``speed``
+        virtual seconds per wall second, catching up in bursts when the
+        host lags). Sealing is lock-append work and runs in its own
+        thread so an in-flight dispatch never stalls the staging clock —
+        which would pool every concurrent arrival into one open tick and
+        trip the k_cap back-pressure for the whole dispatch duration.
+
+        Virtual time slews, never runs away: when dispatch falls behind
+        the requested speed, sealing stops at the lead cap and the
+        achieved virtual rate degrades to dispatch-bound — the live
+        host's achieved_speed contract, with back-pressure (503 quotes)
+        instead of an unbounded sealed backlog."""
+        period = self.cfg.tick_ms / 1000.0 / self.speed
+        # sealed-backlog cap: 2 windows keeps the staging pipeline short —
+        # a staged job is dispatched (and leaves the admission bound's
+        # unseen set) within ~2 window walls, so the queue-budget refill
+        # rate, admission_rate ≈ C·queue_capacity / lead_wall, stays high;
+        # an 8-window lead measured 4x lower sustained admission
+        max_lead = 2 * self.window
+        t0 = time.time()
+        while not self._stop.is_set():
+            due = min(int((time.time() - t0) / period),
+                      self.ticks_dispatched + max_lead)
+            while self._staged_ticks() < due:
+                self.seal_tick()
+            time.sleep(min(max(period / 2, 0.0005), 0.02))
+
+    def _drive_loop(self) -> None:
+        """Dispatch a coalesce window whenever one is sealed — back-to-
+        back when the backlog is deep (throughput degrades to
+        device-bound, never to drops), idle-waiting when traffic is
+        light."""
+        period = self.cfg.tick_ms / 1000.0 / self.speed
+        while not self._stop.is_set():
+            if self._sealed_count() >= self.window:
+                self._dispatch(self.window)
+            else:
+                time.sleep(min(max(period, 0.001), 0.02))
+        # the final flush happens in on_shutdown AFTER this thread and
+        # the pacer are both joined — flushing here would race a pacer
+        # still sealing and strand an acknowledged tick
+
+    # ------------------------------------------------------------------
+    # introspection (drivers/tests; syncs — never called from handlers)
+    # ------------------------------------------------------------------
+    def provenance(self) -> dict:
+        """Serving provenance for bench detail dicts — joinable with
+        tournament/env rows (the PR 6 contract). Batch-size mean/max are
+        whole-run aggregates; the p50 comes from the bounded recent
+        window."""
+        return {
+            "policy": self.engine.policy_provenance(),
+            "coalesce_window_ticks": self.window,
+            "clusters": self.C, "k_cap": self.k_cap,
+            "max_staged": self.max_staged,
+            "snapshot_every": self.snapshot_every,
+            "dispatches": self.dispatches,
+            "ticks_dispatched": self.ticks_dispatched,
+            "batch_jobs": {
+                "mean": round(self._batch_sum / self._batch_n, 2)
+                if self._batch_n else 0.0,
+                "max": self._batch_max,
+                "p50": int(np.percentile(list(self.batch_jobs), 50))
+                if self.batch_jobs else 0},
+            "ragged_k": sorted(self.chunk_k),
+            "rejected_503": self._rejected_count(),
+        }
+
+    def state_host(self):
+        """The full device state coerced to OWNED host numpy (np.array,
+        not a zero-copy view — see _refresh_snapshot) — the bench's
+        parity-comparison and drain probes. Drive thread must be idle."""
+        return jax.tree.map(np.array, self._state)
+
+    def latencies_ms(self) -> list[float]:
+        """Submit-to-placed-visible latency per tracked job: placement
+        tick from the device trace (cfg.record_trace), visibility wall
+        from the dispatch log (the snapshot that made the tick
+        host-readable), submit wall from the staging log."""
+        if not self.track_latency:
+            return []
+        from multi_cluster_simulator_tpu.utils.trace import extract_trace
+        trace = extract_trace(self._state)
+        log = self.visibility_log
+        tick = self.cfg.tick_ms
+        out = []
+        with self._stage_lock:
+            submit = dict(self._submit_wall)
+        for c, events in enumerate(trace):
+            for (t, jid, node, src) in events:
+                t0 = submit.get((c, jid))
+                if t0 is None:
+                    continue
+                # first snapshot whose dispatched ticks cover clock t
+                wall = next((w for (n, w) in log if n * tick >= t), None)
+                if wall is not None:
+                    out.append((wall - t0) * 1000.0)
+        return out

@@ -1,0 +1,42 @@
+"""serve-sync fixture (BAD): handlers that synchronize the device.
+
+Five violation shapes the rule must each surface: an ``np.asarray`` over
+live device state in a routed handler, a ``jax.device_get``, a
+``block_until_ready`` method wait, a sync inside a lambda registered on
+the route table, and a sync HIDDEN one helper call below a handler (the
+transitive same-module closure — the request path is the whole call
+chain, not just the ``_handle_*`` shim). Each one turns a
+stage-and-snapshot handler back into the per-request cost model (one
+device round trip per request)."""
+
+import jax
+import numpy as np
+
+
+class BadFrontDoor:
+    def register_handlers(self):
+        self.httpd.route("POST", "/", self._handle_submit)
+        self.httpd.route("GET", "/depth", self._depth)
+        self.httpd.route(
+            "GET", "/peek",
+            lambda b, h: (200, bytes(int(np.asarray(self.state.t)))))
+
+    def _handle_submit(self, body, headers):
+        depth = int(np.asarray(self.state.jobs_in_queue)[0])  # device sync
+        jax.block_until_ready(self.state.t)  # waits on the hot path
+        return (503 if depth > 64 else 200), None
+
+    def _handle_quote(self, body, headers):
+        wait = jax.device_get(self.state.wait_total)  # device readback
+        return 200, str(float(wait.sum())).encode()
+
+    def _depth(self, body, headers):
+        return 200, str(np.array(self.state.l0.count).sum()).encode()
+
+    def _handle_indirect(self, body, headers):
+        return 200, str(self._depth_helper()).encode()
+
+    def _depth_helper(self):
+        # not a handler itself — but on the request path via
+        # _handle_indirect, so the sync below is still a finding
+        return int(np.asarray(self.state.jobs_in_queue).sum())

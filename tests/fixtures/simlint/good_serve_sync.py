@@ -1,0 +1,34 @@
+"""serve-sync fixture (GOOD): stage-and-snapshot handlers.
+
+Submit handlers parse host JSON and append under a staging lock; read
+handlers answer from the latest immutable snapshot (already host numpy —
+nothing to coerce). The drive loop outside handler scope may synchronize
+freely (that is where snapshots come from)."""
+
+import json
+
+import jax
+import numpy as np
+
+
+class GoodFrontDoor:
+    def register_handlers(self):
+        self.httpd.route("POST", "/", self._handle_submit)
+        self.httpd.route("GET", "/stats", self._handle_stats)
+
+    def _handle_submit(self, body, headers):
+        job = json.loads(body)
+        with self._stage_lock:
+            self._open[int(job.get("Cluster", 0))].append(job)
+        return 200, None
+
+    def _handle_stats(self, body, headers):
+        snap = self._snap  # immutable host view, swapped by the drive loop
+        return 200, json.dumps({
+            "queue_depth": int(snap.queue_depth.sum()),
+            "age_ms": snap.age_ms()}).encode()
+
+    def _refresh_snapshot(self):
+        # drive-thread scope: the sanctioned synchronization point
+        self._snap_depth = np.asarray(self.state.jobs_in_queue)
+        jax.block_until_ready(self.state.t)

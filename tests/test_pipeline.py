@@ -376,3 +376,32 @@ def test_time_compress_requires_tick_arrivals():
     with pytest.raises(ValueError, match="TickArrivals"):
         Engine(cfg).run_compressed(init_state(cfg, _specs(1)),
                                    _bursty_arrivals(1), N_TICKS)
+
+
+def test_run_io_chunks_bit_identical_to_run():
+    """The serving tier's dispatch unit (PR 11): ``Engine.run_io`` — the
+    multi-tick tick_io that consumes a staged TickArrivals chunk per
+    dispatch, emitting stacked per-tick TickIO — composes across chunk
+    boundaries to exactly ``run`` over the same bucketed stream: window
+    size is invisible to the state, and the io block has the per-tick
+    stacked shape."""
+    C = 3
+    arr = _bursty_arrivals(C)
+    cfg = _cfg()
+    eng = Engine(cfg)
+    ta = pack_arrivals_by_tick(arr, N_TICKS, TICK_MS)
+    ref = eng.run_jit()(init_state(cfg, _specs(C)), ta, N_TICKS)
+
+    jfn = eng.run_io_jit(donate=True)
+    s = jax.tree.map(jnp.copy, init_state(cfg, _specs(C)))
+    off = 0
+    for n in (1, 4, 8, 7):  # mixed window sizes across the same stream
+        rows = ta.rows[off:off + n]
+        counts = ta.counts[off:off + n]
+        s, io = jfn(s, rows, counts)
+        assert io.borrow_want.shape == (n, C)
+        assert io.ret_rows.shape[:2] == (n, C)
+        off += n
+    assert off == N_TICKS
+    _assert_trees_equal(ref, jax.block_until_ready(s))
+    assert int(np.asarray(s.placed_total).sum()) > 0

@@ -347,6 +347,290 @@ def test_full_constellation(tmp_path, registry):
 
 
 # ---------------------------------------------------------------------------
+# scheduler host: a full staging ring is a 503, never a silent drop
+# ---------------------------------------------------------------------------
+
+def test_scheduler_ring_full_returns_retryable_503():
+    """PR-11 satellite pin: the live host's submit endpoints answer 503
+    with a retry quote when the arrival ring is full — the old behavior
+    logged an error at drain time and silently dropped a job the client
+    had already seen 200 for. The bound is submit-side (staged <=
+    max_arrivals), so the drain-time drop branch is structurally
+    unreachable; after the tick loop drains the ring, submits succeed
+    again and nothing was lost."""
+    import numpy as np
+
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+
+    cfg = SimConfig(policy=PolicyKind.DELAY, queue_capacity=64,
+                    max_running=64, max_arrivals=6, max_ingest_per_tick=8,
+                    max_nodes=5, max_virtual_nodes=2,
+                    trader=TraderConfig(enabled=False))
+    s = SchedulerService("svc-ringfull", uniform_cluster(1, 5), cfg)
+    for i in range(cfg.max_arrivals):
+        status, _ = s._handle_submit_delay(
+            json.dumps(job_to_json(i + 1, 1, 100, 5_000)).encode(), {})
+        assert status == 200
+    status, body = s._handle_submit_delay(
+        json.dumps(job_to_json(99, 1, 100, 5_000)).encode(), {})
+    assert status == 503
+    quote = json.loads(body)
+    assert quote["RetryAfterMs"] > 0
+    # POST / rejects identically (both submit endpoints share the ring)
+    status, _ = s._handle_submit_fifo(
+        json.dumps(job_to_json(98, 1, 100, 5_000)).encode(), {})
+    assert status == 503
+    assert s.meter.snapshot()["counters"]["submit_rejected"] == 2
+    # the tick loop drains the ring; the client's retry then lands
+    for _ in range(3):
+        s._tick_once()
+    status, _ = s._handle_submit_delay(
+        json.dumps(job_to_json(99, 1, 100, 5_000)).encode(), {})
+    assert status == 200
+    # DELAY places one Level0 head per tick (scheduler.go:332-366)
+    for _ in range(12):
+        if s.stats()["placed_total"] == cfg.max_arrivals + 1:
+            break
+        s._tick_once()
+    drops = total_drops(s.state)
+    assert all(v == 0 for v in drops.values()), drops
+    # every 200-acknowledged job is accounted for on the device
+    assert s.stats()["placed_total"] == cfg.max_arrivals + 1
+    assert int(np.asarray(s.state.arr_ptr)[0]) >= 0
+
+
+# ---------------------------------------------------------------------------
+# serving tier: the batched front door (services/serving.py)
+# ---------------------------------------------------------------------------
+
+def serving_cfg(**kw):
+    base = dict(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                queue_capacity=64, max_running=64, max_arrivals=8,
+                max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _serving_trace(C, T, seed, mismatched_every=0):
+    """Deterministic per-tick job lists: [(c, id, cores, mem, dur,
+    mismatched_endpoint)]."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    out, jid = [], 1
+    for t in range(T):
+        row = []
+        for c in range(C):
+            for _ in range(int(rng.integers(0, 3))):
+                mism = bool(mismatched_every
+                            and jid % mismatched_every == 0)
+                row.append((c, jid, int(rng.integers(1, 4)),
+                            int(rng.integers(100, 2000)),
+                            int(rng.integers(1000, 8001)), mism))
+                jid += 1
+        out.append(row)
+    return out
+
+
+def _drive_serving_http(specs, cfg, tick_jobs, window):
+    """Drive a deterministic paced front door over real HTTP: per-cluster
+    submitter threads (concurrent across clusters — rank order inside a
+    (tick, cluster) bucket only depends on per-cluster submission order),
+    one seal per tick, one dispatch per window."""
+    import threading
+
+    from multi_cluster_simulator_tpu.services.serving import (
+        ServingScheduler,
+    )
+
+    s = ServingScheduler("svc-front", specs, cfg, pacer=False,
+                         window=window, warm_k=(4,), k_cap=32,
+                         max_staged=10 ** 6)
+    s.start()
+    try:
+        for t, row in enumerate(tick_jobs):
+            by_c = {}
+            for job in row:
+                by_c.setdefault(job[0], []).append(job)
+
+            def submit(jobs):
+                for (c, j, cores, mem, dur, mism) in jobs:
+                    ep = "/delay" if mism else "/"
+                    code, _ = httpd.post_json(
+                        s.url + ep,
+                        {**job_to_json(j, cores, mem, dur), "Cluster": c})
+                    assert code == 200, f"job {j} -> {code}"
+
+            ths = [threading.Thread(target=submit, args=(jobs,))
+                   for jobs in by_c.values()]
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            s.seal_tick()
+            if (t + 1) % window == 0:
+                s.dispatch_sealed()
+        s.dispatch_sealed()
+        return s, s.state_host()
+    finally:
+        s.shutdown()
+
+
+def test_serving_front_door_bit_identical_to_per_request_path():
+    """The tentpole parity pin: the same trace (both endpoints, real
+    HTTP, concurrent per-cluster submitters) through a window-1 front
+    door (the per-request cost model) and a window-4 front door must
+    produce BIT-IDENTICAL device states — coalescing arrivals across
+    ticks and clusters is invisible to placement."""
+    import jax
+    import numpy as np
+
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+
+    C, T = 3, 24
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    tick_jobs = _serving_trace(C, T, seed=5, mismatched_every=9)
+    _, state_1 = _drive_serving_http(specs, serving_cfg(), tick_jobs, 1)
+    _, state_4 = _drive_serving_http(specs, serving_cfg(), tick_jobs, 4)
+    for la, lb in zip(jax.tree.leaves(state_1), jax.tree.leaves(state_4)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    drops = total_drops(state_4)
+    assert all(v == 0 for v in drops.values()), drops
+    assert int(np.asarray(state_4.placed_total).sum()) > 0
+
+
+def test_serving_front_door_matches_batch_engine():
+    """The staged path IS the batch engine: a policy-endpoint-only trace
+    through the HTTP front door equals ``Engine.run_jit`` over the
+    equivalent bucketed Arrivals (stamps = the staging ticks' clocks) —
+    the serving tier adds a wire, not semantics."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from multi_cluster_simulator_tpu.core.engine import (
+        Engine, pack_arrivals_by_tick,
+    )
+    from multi_cluster_simulator_tpu.core.state import Arrivals, init_state
+
+    C, T = 3, 20
+    cfg = serving_cfg()
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    tick_jobs = _serving_trace(C, T, seed=13)
+    _, state_srv = _drive_serving_http(specs, cfg, tick_jobs, 4)
+
+    # equivalent Arrivals stream: each job stamped with its staging
+    # tick's clock, per-cluster in submission order
+    rows = {c: [] for c in range(C)}
+    for t, row in enumerate(tick_jobs):
+        for (c, j, cores, mem, dur, _m) in row:
+            rows[c].append((j, cores, mem, dur, (t + 1) * cfg.tick_ms))
+    A = max(len(v) for v in rows.values())
+    arr = {k: np.zeros((C, A), np.int32)
+           for k in ("t", "id", "cores", "mem", "gpu", "dur")}
+    n = np.zeros((C,), np.int32)
+    for c, lst in rows.items():
+        n[c] = len(lst)
+        for i, (j, cores, mem, dur, ta) in enumerate(lst):
+            arr["id"][c, i], arr["cores"][c, i] = j, cores
+            arr["mem"][c, i], arr["dur"][c, i] = mem, dur
+            arr["t"][c, i] = ta
+    arrivals = Arrivals(t=jnp.asarray(arr["t"]), id=jnp.asarray(arr["id"]),
+                        cores=jnp.asarray(arr["cores"]),
+                        mem=jnp.asarray(arr["mem"]),
+                        gpu=jnp.asarray(arr["gpu"]),
+                        dur=jnp.asarray(arr["dur"]), n=jnp.asarray(n))
+    ta_bucketed = pack_arrivals_by_tick(arrivals, T, cfg.tick_ms)
+    ref = Engine(cfg).run_jit()(init_state(cfg, specs), ta_bucketed, T)
+    for la, lb in zip(jax.tree.leaves(state_srv), jax.tree.leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_serving_snapshot_queries_answer_without_device():
+    """The query side-channel: /stats, /quote and /placed answer from the
+    drive loop's immutable snapshots — every response carries its
+    snapshot age, and placement lookups see a long-running job appear in
+    the running set."""
+    from multi_cluster_simulator_tpu.services.serving import (
+        ServingScheduler,
+    )
+
+    C = 2
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    s = ServingScheduler("svc-snap", specs, serving_cfg(), pacer=False,
+                         window=2, warm_k=(4,), k_cap=8, max_staged=64)
+    s.start()
+    try:
+        code, _ = httpd.post_json(
+            s.url + "/", {**job_to_json(7, 2, 500, 600_000), "Cluster": 1})
+        assert code == 200
+        # staged, not yet dispatched: unknown to the snapshot
+        code, body = httpd.get(s.url + "/placed?cluster=1&id=7")
+        assert code == 200 and json.loads(body)["status"] == "unknown"
+        s.seal_tick()
+        s.dispatch_sealed()
+        code, body = httpd.get(s.url + "/placed?cluster=1&id=7")
+        d = json.loads(body)
+        assert d["status"] == "running" and d["snapshot_age_ms"] >= 0
+        code, body = httpd.get(s.url + "/stats")
+        d = json.loads(body)
+        assert d["placed_total"] == 1 and d["staged_jobs"] == 0
+        code, body = httpd.get(s.url + "/quote?cluster=1")
+        d = json.loads(body)
+        assert d["wait_quote_ms"] >= 0 and "queue_depth" in d
+        code, _ = httpd.get(s.url + "/quote?cluster=9")
+        assert code == 400
+    finally:
+        s.shutdown()
+
+
+def test_serving_backpressure_quotes_and_recovers():
+    """Explicit back-pressure: a full staging ring answers 503 with a
+    machine-readable quote (RetryAfterMs + RejectedIdx), counts the
+    rejection in telemetry, drops NOTHING on the device, and admits the
+    retry once the ring turns over. Batch submits are admitted per job —
+    the accepted prefix stays staged."""
+    import numpy as np
+
+    from multi_cluster_simulator_tpu.services.serving import (
+        ServingScheduler,
+    )
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+
+    C = 2
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    s = ServingScheduler("svc-bp", specs, serving_cfg(), pacer=False,
+                         window=1, warm_k=(4,), k_cap=8, max_staged=4)
+    s.start()
+    try:
+        batch = [{**job_to_json(i + 1, 1, 100, 2_000), "Cluster": i % C}
+                 for i in range(6)]
+        code, body = httpd.post_json(s.url + "/submitBatch", batch)
+        assert code == 503
+        d = json.loads(body)
+        assert d["Accepted"] == 4 and len(d["RejectedIdx"]) == 2
+        assert d["RetryAfterMs"] > 0
+        assert s.meter.snapshot()["counters"]["submit_rejected"] == 2
+        # single-job submit also quotes
+        code, body = httpd.post_json(
+            s.url + "/", {**job_to_json(9, 1, 100, 2_000), "Cluster": 0})
+        assert code == 503 and json.loads(body)["RetryAfterMs"] > 0
+        # the ring turns over; the client's retry of the rejected tail lands
+        s.seal_tick()
+        s.dispatch_sealed()
+        retry = [batch[k] for k in d["RejectedIdx"]]
+        code, body = httpd.post_json(s.url + "/submitBatch", retry)
+        assert code == 200 and json.loads(body)["Accepted"] == 2
+        s.seal_tick()
+        s.dispatch_sealed()
+        drops = total_drops(s.state_host())
+        assert all(v == 0 for v in drops.values()), drops
+        assert s.snapshot.placed == 6
+        assert int(np.asarray(s.state_host().placed_total).sum()) == 6
+    finally:
+        s.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # scheduler host: handlers never block on the in-flight tick device call
 # ---------------------------------------------------------------------------
 

@@ -71,6 +71,7 @@ FIXTURE_RULES = [
     ("bad_policy_kernel.py", "policy-kernel"),
     ("bad_env_rng.py", "env-rng"),
     ("bad_shard_exchange.py", "shard-exchange"),
+    ("bad_serve_sync.py", "serve-sync"),
     ("bad_pragma.py", "pragma-no-reason"),
     ("bad_pragma.py", "pragma-stale"),
 ]
@@ -328,6 +329,86 @@ def test_bench_chunk_rule_engages_with_the_real_driver(tmp_path):
 # (c) the suppression-pragma path
 # ---------------------------------------------------------------------------
 
+def test_bad_serve_sync_flags_every_violation_shape():
+    """The fixture carries six shapes — an np.asarray and a
+    block_until_ready inside a routed ``_handle_`` method, a
+    jax.device_get in a ``_handle_``-named method, an np.array in a
+    function registered via .route by name, an np.asarray inside an
+    inline route lambda, and a sync hidden one helper call below a
+    handler (the transitive same-module closure) — and each must surface
+    as its own serve-sync finding."""
+    findings = [f for f in run(str(FIXTURES / "bad_serve_sync.py"))
+                if f.rule == "serve-sync"]
+    assert len(findings) == 6, "\n".join(f.render() for f in findings)
+
+
+def test_good_serve_sync_fixture_is_clean():
+    """The paired clean version — stage-only submit, snapshot-only reads,
+    with the drive thread's sanctioned synchronization OUTSIDE handler
+    scope — must not trip serve-sync (or anything else)."""
+    findings = run(str(FIXTURES / "good_serve_sync.py"))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    proc = _cli(str(FIXTURES / "good_serve_sync.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_serve_sync_reaches_the_real_serving_tier(tmp_path):
+    """serve-sync provably engages with services/serving.py's real
+    handlers: paste one device coercion into the stats handler and the
+    rule must fire — so the package analyzing clean can never mean
+    'checked nothing'."""
+    src = (PKG_DIR / "services" / "serving.py").read_text()
+    anchor = '''    def _handle_stats(self, body: bytes, headers: dict):
+        """GET /stats — constellation totals from the latest snapshot
+        (never the device)."""
+        s = self._snap
+'''
+    bad = src.replace(
+        anchor,
+        anchor + "        depth = int(np.asarray("
+                 "self._state.jobs_in_queue)[0])\n", 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "serving_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "serve-sync" for x in run(str(f)))
+
+
+def test_serve_sync_reaches_the_real_submit_helpers(tmp_path):
+    """The transitive closure provably covers the helpers the submit
+    handlers actually run (the request path is _handle_* -> _submit_one
+    -> _stage): paste a device coercion into _stage — two calls below
+    the route table — and the rule must still fire."""
+    src = (PKG_DIR / "services" / "serving.py").read_text()
+    anchor = "        now = time.time() if self.track_latency else 0.0\n"
+    bad = src.replace(
+        anchor,
+        anchor + "        depth = int(np.asarray("
+                 "self._state.jobs_in_queue)[0])\n", 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "serving_bad_helper.py"
+    f.write_text(bad)
+    assert any(x.rule == "serve-sync" for x in run(str(f)))
+
+
+def test_serve_sync_sanctions_the_per_request_hosts():
+    """The per-request reference hosts (scheduler_host.py & friends) ARE
+    the measured blocking baseline — their handlers faithfully reproduce
+    Go's per-request syncs and are sanctioned wholesale, not pragma'd."""
+    findings = [f for f in
+                run(str(PKG_DIR / "services" / "scheduler_host.py"))
+                if f.rule == "serve-sync"]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_serve_sync_scopes_the_services_package():
+    from tools.simlint.runner import SERVE_SYNC_DIRS
+
+    modules, _ = load_target(str(PKG_DIR))
+    tops = {m.relpath.split("/", 1)[0] for m in modules if m.relpath}
+    assert set(SERVE_SYNC_DIRS) <= tops, \
+        "services/ not loaded — the serve-sync scope is empty"
+
+
 def test_pragma_with_reason_suppresses(tmp_path):
     f = tmp_path / "suppressed.py"
     f.write_text(
@@ -434,7 +515,7 @@ def test_lockset_parses_scheduler_host_real_annotation():
     guards = locks["SchedulerService"].guards
     assert set(guards["_slock"]) >= {"state", "_arr", "_arr_n", "_journal",
                                      "_owner_urls", "_owner_idx"}
-    assert guards["_plock"] == ("_pending",)
+    assert guards["_plock"] == ("_pending", "_staged_n")
     owner = locks["SchedulerService"].owner
     assert owner["state"] == "_slock" and owner["_pending"] == "_plock"
 

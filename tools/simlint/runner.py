@@ -7,7 +7,7 @@ from typing import Iterable, Optional
 
 from tools.simlint import (
     compactstore, determinism, envrng, findings as F, lockset, policykernel,
-    purity, shardexchange,
+    purity, servesync, shardexchange,
 )
 from tools.simlint.callgraph import CallGraph
 from tools.simlint.project import Module, in_scope, load_target
@@ -46,10 +46,16 @@ ENV_RNG_RULES = ("env-rng",)
 SHARD_EXCHANGE_DIRS = ("core", "ops", "market", "envs", "policies",
                        "workload", "parallel")
 SHARD_EXCHANGE_RULES = ("shard-exchange",)
+# serving-tier handler discipline (ISSUE 11): no blocking device syncs in
+# HTTP/gRPC handler scope — handlers stage and read snapshots only; the
+# per-request reference hosts are sanctioned inside the pass (they ARE the
+# measured blocking baseline, BENCH `live`)
+SERVE_SYNC_DIRS = ("services",)
+SERVE_SYNC_RULES = ("serve-sync",)
 PRAGMA_RULES = ("pragma-no-reason", "pragma-stale")
 ALL_RULES = (PURITY_RULES + LOCKSET_RULES + DET_RULES + COMPACT_RULES
              + POLICY_KERNEL_RULES + ENV_RNG_RULES + SHARD_EXCHANGE_RULES
-             + PRAGMA_RULES)
+             + SERVE_SYNC_RULES + PRAGMA_RULES)
 
 
 def run(target: str, rules: Optional[Iterable[str]] = None,
@@ -91,6 +97,10 @@ def run(target: str, rules: Optional[Iterable[str]] = None,
                 or shardexchange.module_is_shard_scope(mod)):
             raw += shardexchange.check_module(mod)
             checked.update(SHARD_EXCHANGE_RULES)
+        if in_scope(mod, SERVE_SYNC_DIRS) and (
+                mod.relpath != "" or servesync.module_is_service(mod)):
+            raw += servesync.check_module(mod)
+            checked.update(SERVE_SYNC_RULES)
 
     if selected is not None:
         raw = [f for f in raw if f.rule in selected]

@@ -1,0 +1,178 @@
+"""serve-sync pass: no blocking device syncs in HTTP/gRPC handler scope.
+
+The serving tier's load-bearing contract (services/serving.py,
+ARCHITECTURE.md §serving tier): request handlers only STAGE host tuples
+and READ the latest immutable snapshot — the device hot path is never
+synchronized on a request's behalf. One ``np.asarray(self.state...)`` in a
+handler silently reintroduces the per-request cost model the serving tier
+exists to delete (a device round trip per request — the live path's 113
+jobs/s), without failing any functional test: everything still works, just
+100x slower under load. So the discipline is machine-checked.
+
+**Handler scope** is (a) any function whose name starts with ``_handle_``
+(the services/ route-handler convention), (b) any function or lambda
+registered via a ``.route(METHOD, PATH, fn)`` call, and (c) every function
+nested inside one. Inside that scope the pass flags the blocking
+coercions:
+
+- ``np.asarray`` / ``np.array`` calls (device sync when fed a jax array —
+  and a handler has no business coercing anything: snapshots are already
+  host numpy),
+- ``jax.device_get``,
+- any ``.block_until_ready(...)`` call (method or ``jax.block_until_ready``).
+
+**Sanctioned modules** — the per-request reference hosts, whose handlers
+ARE the measured blocking baseline (scheduler_host.py, trader_host.py,
+registry.py, workload.py, logsink.py, rpc.py, main.py): they reproduce the
+Go reference's handler semantics job-by-job (BENCH ``live`` measures
+exactly that cost), so the rule exempts them wholesale rather than
+pragma-ing every faithful sync. Every OTHER module in services/ — the
+serving tier and anything that joins it — must stay stage-and-snapshot
+only.
+
+Standalone-file targets engage this family only when the file looks like a
+service with handlers (``module_is_service``), the same single-file
+convention gate the policy-kernel/env-rng families use.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+RULE = "serve-sync"
+
+# the per-request reference surface: handlers faithfully reproduce the Go
+# reference's blocking semantics and are the measured baseline
+SANCTIONED = ("scheduler_host.py", "trader_host.py", "registry.py",
+              "workload.py", "logsink.py", "rpc.py", "main.py")
+
+
+def module_is_service(mod: Module) -> bool:
+    """Single-file convention gate: engage only for files that register
+    route handlers (or use the ``_handle_`` naming convention)."""
+    return ".route(" in mod.source or "_handle_" in mod.source
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _numpy_heads(mod: Module) -> set[str]:
+    heads = {"numpy"}
+    for alias, full in mod.module_aliases.items():
+        if full == "numpy":
+            heads.add(alias)
+    return heads
+
+
+def _jax_heads(mod: Module) -> set[str]:
+    heads = {"jax"}
+    for alias, full in mod.module_aliases.items():
+        if full == "jax":
+            heads.add(alias)
+    return heads
+
+
+def _handler_functions(tree) -> list:
+    """Handler scope: ``_handle_*``-named functions, everything registered
+    through a ``.route(...)`` call (by name or inline lambda), AND the
+    transitive same-module callees of those roots — a handler that hides
+    its device sync one ``self._helper()`` hop down is still on the
+    request path (serving.py's real submit work lives in ``_submit_one``
+    and ``_stage``, not in the ``_handle_*`` shims). Callees are resolved
+    by name against the module's own function/method defs; calls into
+    other modules (``json.loads``, ``self.meter.add``) are out of scope
+    by construction."""
+    routed_names: set[str] = set()
+    lambdas: list = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "route" and len(node.args) >= 3):
+            continue
+        fn = node.args[2]
+        if isinstance(fn, ast.Lambda):
+            lambdas.append(fn)
+        elif isinstance(fn, ast.Attribute):
+            routed_names.add(fn.attr)
+        elif isinstance(fn, ast.Name):
+            routed_names.add(fn.id)
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    roots = [defs[n] for n in defs
+             if n.startswith("_handle_") or n in routed_names]
+    scope = {id(f): f for f in roots}
+    for lam in lambdas:
+        scope[id(lam)] = lam
+    # fixpoint over same-module callees: self.X(...) and bare X(...)
+    # resolve by their final attribute/name against the module defs
+    frontier = list(scope.values())
+    while frontier:
+        fn = frontier.pop()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = None
+            if isinstance(f, ast.Name):
+                name = f.id
+            elif isinstance(f, ast.Attribute):
+                name = f.attr
+            callee = defs.get(name) if name else None
+            if callee is not None and id(callee) not in scope:
+                scope[id(callee)] = callee
+                frontier.append(callee)
+    return list(scope.values())
+
+
+def check_module(mod: Module) -> list[Finding]:
+    if any(mod.path.endswith(s) for s in SANCTIONED):
+        return []
+    out: list[Finding] = []
+    np_heads = _numpy_heads(mod)
+    jax_heads = _jax_heads(mod)
+    seen: set[int] = set()
+    for fn in _handler_functions(mod.tree):
+        name = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            if id(node) in seen or not isinstance(node, ast.Call):
+                continue
+            seen.add(id(node))
+            d = _dotted(node.func)
+            head, _, tail = d.partition(".")
+            msg = None
+            if head in np_heads and tail in ("asarray", "array"):
+                msg = (f"{d}() in handler scope ({name}): a handler may "
+                       "only stage host tuples and read snapshots — "
+                       "coercing device state here syncs the hot path "
+                       "per request (the per-request cost model the "
+                       "serving tier deletes)")
+            elif head in jax_heads and tail == "device_get":
+                msg = (f"{d}() in handler scope ({name}): device readback "
+                       "belongs in the drive thread's snapshot refresh, "
+                       "never on the request path")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "block_until_ready"):
+                msg = (f"block_until_ready in handler scope ({name}): a "
+                       "handler must never wait on the device — answer "
+                       "from the latest snapshot")
+            elif head in jax_heads and tail == "block_until_ready":
+                msg = (f"{d}() in handler scope ({name}): a handler must "
+                       "never wait on the device — answer from the "
+                       "latest snapshot")
+            if msg is not None:
+                out.append(Finding(mod.path, node.lineno, RULE, msg))
+    out.sort(key=lambda f: (f.line, f.message))
+    return out
