@@ -52,6 +52,8 @@ from flax import struct
 from multi_cluster_simulator_tpu.config import SimConfig
 from multi_cluster_simulator_tpu.core import state as st
 from multi_cluster_simulator_tpu.core.state import Arrivals, SimState
+from multi_cluster_simulator_tpu.obs import device as obs_device
+from multi_cluster_simulator_tpu.obs.profile import phase_scope
 from multi_cluster_simulator_tpu.ops import fields as F
 from multi_cluster_simulator_tpu.ops import placement as P
 from multi_cluster_simulator_tpu.ops import queues as Q
@@ -663,7 +665,7 @@ class Engine:
                           tick_indexed=True, params=params)[0]
 
     def _tick(self, state: SimState, packed_arrivals, emit_io: bool,
-              tick_indexed: bool = False, params=None):
+              tick_indexed: bool = False, params=None, phase_limit=None):
         """The tick body. ``emit_io=False`` (the batch/scan path) skips the
         TickIO packing work when borrowing doesn't need it — the return-slot
         argsort is per-tick cost the headline config shouldn't pay.
@@ -671,10 +673,18 @@ class Engine:
         (rows [C, K, NF], counts [C]) TickArrivals slice instead of the
         whole stream. ``params``: the PolicyParams pytree selecting and
         parameterizing the scheduling pass (None = this engine's
-        config-derived defaults, baked as constants)."""
+        config-derived defaults, baked as constants). ``phase_limit``:
+        static int truncating the body after the first N phases
+        (obs.profile.TICK_PHASES order) — the profile plane's ablation
+        hook (``run_prefix``/tools/profile_capture.py); None runs all 7.
+        Every phase is wrapped in a ``jax.named_scope`` so profiler
+        captures attribute device time per phase (trace-time metadata
+        only — bitwise invisible to the compiled program's results)."""
         cfg = self.cfg
         if params is None:
             params = self._default_params
+        phase_on = (lambda k: True) if phase_limit is None else \
+            (lambda k: k <= phase_limit)
         t = state.t + cfg.tick_ms
 
         # compact node storage: widen ONCE at tick entry so every phase
@@ -691,26 +701,34 @@ class Engine:
                                   node_cap=F.widen(state.node_cap))
 
         # 1. completions (+ returns of finished foreign jobs)
-        run_before = state.run
-        st2, done = jax.vmap(_release_local, in_axes=(_STATE_AXES, None),
-                             out_axes=(_STATE_AXES, 0))(state, t)
-        state = st2
-        if cfg.borrowing or emit_io:
-            ret_rows, ret_valid, ret_dropped = _pack_returns(
-                run_before, done, cfg.max_msgs)
-            state = state.replace(drops=state.drops.replace(
-                msgs=state.drops.msgs + ret_dropped))
-        else:
-            C = done.shape[0]
-            ret_rows = jnp.zeros((C, cfg.max_msgs, R.RF), jnp.int32)
-            ret_valid = jnp.zeros((C, cfg.max_msgs), bool)
-        if cfg.borrowing:
-            state = _deliver_returns(state, ret_rows, ret_valid, self.ex)
+        with phase_scope("release"):
+            if phase_on(1):
+                run_before = state.run
+                st2, done = jax.vmap(_release_local,
+                                     in_axes=(_STATE_AXES, None),
+                                     out_axes=(_STATE_AXES, 0))(state, t)
+                state = st2
+            else:
+                done = jnp.zeros(state.run.active.shape, bool)
+            if phase_on(1) and (cfg.borrowing or emit_io):
+                ret_rows, ret_valid, ret_dropped = _pack_returns(
+                    run_before, done, cfg.max_msgs)
+                state = state.replace(drops=state.drops.replace(
+                    msgs=state.drops.msgs + ret_dropped))
+            else:
+                C = done.shape[0]
+                ret_rows = jnp.zeros((C, cfg.max_msgs, R.RF), jnp.int32)
+                ret_valid = jnp.zeros((C, cfg.max_msgs), bool)
+            if phase_on(1) and cfg.borrowing:
+                state = _deliver_returns(state, ret_rows, ret_valid, self.ex)
 
         # 2. virtual-node expiry (off in parity mode — reference keeps them)
-        if cfg.trader.enabled and cfg.trader.expire_virtual_nodes:
-            state = jax.vmap(_expire_vnodes_local, in_axes=(_STATE_AXES, None),
-                             out_axes=_STATE_AXES)(state, t)
+        if cfg.trader.enabled and cfg.trader.expire_virtual_nodes \
+                and phase_on(2):
+            with phase_scope("expire"):
+                state = jax.vmap(_expire_vnodes_local,
+                                 in_axes=(_STATE_AXES, None),
+                                 out_axes=_STATE_AXES)(state, t)
 
         # 3. arrivals — the ingest target is the active policy's (Level0
         # for the queue-sweep families, ReadyQueue for FIFO). Static when
@@ -726,33 +744,46 @@ class Engine:
                 in_axes=(_STATE_AXES, 0, 0, None),
                 out_axes=_STATE_AXES)(s_, arr_rows, arr_n, t)
 
-        to_delay = self.pset.ingest_to_delay()
-        if to_delay is not None:
-            state = run_ingest(state, to_delay)
-        else:
-            flag = self.pset.to_delay_table()[params.idx]
-            state = jax.lax.cond(flag,
-                                 lambda s_: run_ingest(s_, True),
-                                 lambda s_: run_ingest(s_, False), state)
+        if phase_on(3):
+            with phase_scope("ingest"):
+                to_delay = self.pset.ingest_to_delay()
+                if to_delay is not None:
+                    state = run_ingest(state, to_delay)
+                else:
+                    flag = self.pset.to_delay_table()[params.idx]
+                    state = jax.lax.cond(flag,
+                                         lambda s_: run_ingest(s_, True),
+                                         lambda s_: run_ingest(s_, False),
+                                         state)
 
         # 4. scheduling pass: the policy zoo's dispatch (policies/base.py) —
         # the member params.idx selects runs its batched kernel; non-FIFO
         # members emit an all-False borrow_want
-        state, want, bjob_vec = self.pset.dispatch(state, t, params, cfg)
+        if phase_on(4):
+            with phase_scope("schedule"):
+                state, want, bjob_vec = self.pset.dispatch(state, t, params,
+                                                           cfg)
+        else:
+            C = state.arr_ptr.shape[0]
+            want = jnp.zeros((C,), bool)
+            bjob_vec = jnp.zeros((C, Q.NF), jnp.int32)
         # 5. borrow matching (FIFO-family cells only: want is identically
         # False elsewhere, making the match a bitwise no-op for those cells)
-        if cfg.borrowing and self.pset.has_fifo:
-            state = _borrow_match(state, want, Q.JobRec(vec=bjob_vec), cfg,
-                                  self.ex)
+        if cfg.borrowing and self.pset.has_fifo and phase_on(5):
+            with phase_scope("borrow"):
+                state = _borrow_match(state, want, Q.JobRec(vec=bjob_vec),
+                                      cfg, self.ex)
 
         # 6. trader state snapshot (before any trade in the same tick — the
         # stream lands just ahead of the monitor wakeup, MARKET.md §clock)
-        if cfg.trader.enabled:
-            state = _snapshot(state, t, cfg)
+        if cfg.trader.enabled and phase_on(6):
+            with phase_scope("snapshot"):
+                state = _snapshot(state, t, cfg)
 
         # 7. trader market round
-        if self._trade_round is not None:
-            state = self._trade_round(state, t)
+        if self._trade_round is not None and phase_on(7):
+            with phase_scope("trade"):
+                state = self._trade_round(state, t)
 
         if node_narrow:
             # CHECKED, unlike the interior permutation narrows: the plan's
@@ -777,7 +808,7 @@ class Engine:
 
     # -- scan driver --
     def run(self, state: SimState, arrivals: Arrivals, n_ticks: int,
-            params=None):
+            params=None, mbuf=None):
         """Advance ``n_ticks``. Returns the final state — or, when
         ``cfg.record_metrics`` is set, ``(state, MetricSample)`` with [T] /
         [T, C] stacked per-tick series (the batch-engine form of RunMetrics'
@@ -793,34 +824,79 @@ class Engine:
         ``params`` (PolicyParams) selects/parameterizes the policy per call
         — traced data, so a tournament can vmap this function over a
         (policy, seed) axis with one compile (tools/tournament.py); None
-        bakes this engine's config-derived defaults."""
+        bakes this engine's config-derived defaults.
+
+        ``mbuf`` (obs.MetricsBuffer) engages the device metrics plane: the
+        buffer rides the scan carry, a tap after every tick reads the
+        state (never writes it — the obs-tap contract), and the updated
+        buffer is appended LAST to the return tuple for the caller to
+        thread into the next chunk and harvest at a chunk boundary."""
         record = self.cfg.record_metrics
+        obs = mbuf is not None
+        tick_ms = self.cfg.tick_ms
+
+        def finish(state, series, mb):
+            # the returned buffer stays SHARD-LOCAL (it is a carry: the
+            # caller threads it into the next chunk, and a reduction here
+            # would double count partials on the next boundary) — the
+            # global view reduces through the exchange exactly once, at
+            # harvest (ShardedEngine.collect_metrics / obs.reduce_metrics)
+            out = (state,) + ((series,) if record else ()) \
+                + ((mb,) if obs else ())
+            return out if len(out) > 1 else out[0]
+
+        cur0 = obs_device.cursor_of(state) if obs else None
         if isinstance(arrivals, st.TickArrivals):
             if arrivals.rows.shape[0] < n_ticks:
                 raise ValueError(
                     f"TickArrivals covers {arrivals.rows.shape[0]} ticks, "
                     f"run asked for {n_ticks}")
 
-            def body_ta(s, x):
+            def body_ta(carry, x):
+                s, mb, cur = carry
                 s2 = self._tick(s, x, emit_io=False, tick_indexed=True,
                                 params=params)[0]
-                return s2, (st.metric_sample(s2) if record else None)
+                if obs:
+                    mb, cur = obs_device.tap_tick(mb, cur, s2, tick_ms)
+                return (s2, mb, cur), (st.metric_sample(s2) if record
+                                       else None)
 
             xs = (arrivals.rows[:n_ticks], arrivals.counts[:n_ticks])
-            state, series = jax.lax.scan(body_ta, state, xs, length=n_ticks)
-            return (state, series) if record else state
+            (state, mbuf, _), series = jax.lax.scan(
+                body_ta, (state, mbuf, cur0), xs, length=n_ticks)
+            return finish(state, series, mbuf)
 
         packed = pack_arrivals(arrivals)  # once, outside the tick scan
 
-        def body(s, _):
+        def body(carry, _):
+            s, mb, cur = carry
             s2 = self._tick(s, packed, emit_io=False, params=params)[0]
-            return s2, (st.metric_sample(s2) if record else None)
+            if obs:
+                mb, cur = obs_device.tap_tick(mb, cur, s2, tick_ms)
+            return (s2, mb, cur), (st.metric_sample(s2) if record else None)
 
-        state, series = jax.lax.scan(body, state, None, length=n_ticks)
-        return (state, series) if record else state
+        (state, mbuf, _), series = jax.lax.scan(body, (state, mbuf, cur0),
+                                                None, length=n_ticks)
+        return finish(state, series, mbuf)
+
+    def run_prefix(self, state: SimState, arrivals: st.TickArrivals,
+                   n_ticks: int, phase_limit: int, params=None):
+        """``run`` over a pre-bucketed stream with the tick body truncated
+        after the first ``phase_limit`` phases (obs.profile.TICK_PHASES
+        order) — the profile plane's ablation driver: the cost of phase k
+        at a real shape is wall(prefix k) - wall(prefix k-1), measured on
+        the REAL tick body of any config (tools/profile_capture.py), not a
+        hand-copied phase closure. Diagnostic only: a truncated tick is
+        not a simulation."""
+        def body(s, x):
+            return self._tick(s, x, emit_io=False, tick_indexed=True,
+                              params=params, phase_limit=phase_limit)[0], None
+
+        xs = (arrivals.rows[:n_ticks], arrivals.counts[:n_ticks])
+        return jax.lax.scan(body, state, xs, length=n_ticks)[0]
 
     def run_io(self, state: SimState, rows: jax.Array, counts: jax.Array,
-               params=None):
+               params=None, mbuf=None):
         """Multi-tick ``tick_io``: advance one staged TickArrivals chunk
         (``rows [T, C, K, NF]`` / ``counts [T, C]``) in a single device
         dispatch, emitting the host-visible ``TickIO`` events of every tick
@@ -838,13 +914,22 @@ class Engine:
         at the coalesce window and pow2-bucket K so compile count stays
         bounded at log2(max K) (the pack_arrivals_chunks discipline)."""
 
-        def body(s, x):
+        obs = mbuf is not None
+        tick_ms = self.cfg.tick_ms
+        cur0 = obs_device.cursor_of(state) if obs else None
+
+        def body(carry, x):
+            s, mb, cur = carry
             r, c = x
             s2, io = self._tick(s, (r, c), emit_io=True, tick_indexed=True,
                                 params=params)
-            return s2, io
+            if obs:
+                mb, cur = obs_device.tap_tick(mb, cur, s2, tick_ms)
+            return (s2, mb, cur), io
 
-        return jax.lax.scan(body, state, (rows, counts))
+        (state, mbuf, _), io = jax.lax.scan(body, (state, mbuf, cur0),
+                                            (rows, counts))
+        return (state, io, mbuf) if obs else (state, io)
 
     def run_io_jit(self, donate: bool = False):
         """A jitted ``run_io`` (same donation contract as ``run_jit``):
@@ -869,7 +954,7 @@ class Engine:
 
     # -- event-compressed driver --
     def run_compressed(self, state: SimState, arrivals: st.TickArrivals,
-                       n_ticks: int, params=None):
+                       n_ticks: int, params=None, mbuf=None):
         """``run`` with event-compressed virtual time: a ``while_loop`` that
         executes a real 7-phase tick only when something can happen, and
         otherwise leaps the clock to the next event in one step — the
@@ -891,7 +976,13 @@ class Engine:
         when ``cfg.record_metrics``: the dense per-tick series is
         reconstructed exactly — executed ticks write their sample at their
         tick index, skipped ticks replicate the fixed point with the
-        closed-form wait accrual folded into ``avg_wait_ms``."""
+        closed-form wait accrual folded into ``avg_wait_ms``.
+
+        ``mbuf`` engages the device metrics plane (appended LAST to the
+        return tuple, like ``run``): executed ticks tap normally and the
+        skipped ticks' samples are applied in closed form
+        (``obs.tap_leap``), so the harvested buffer is bit-identical to
+        the dense scan's — tests/test_obs.py pins it."""
         cfg = self.cfg
         if params is None:
             params = self._default_params
@@ -904,6 +995,7 @@ class Engine:
                 f"TickArrivals covers {arrivals.rows.shape[0]} ticks, "
                 f"run asked for {n_ticks}")
         record = cfg.record_metrics
+        obs = mbuf is not None
         C = state.arr_ptr.shape[0]
         stats = st.leap_stats_init()
         if record:
@@ -913,8 +1005,14 @@ class Engine:
                 avg_wait_ms=jnp.zeros((n_ticks, C), jnp.float32))
         else:
             ser0 = None
+
+        def finish(state, ser, stats, mb):
+            out = (state,) + ((ser,) if record else ()) + (stats,) \
+                + ((mb,) if obs else ())
+            return out
+
         if n_ticks == 0:
-            return (state, ser0, stats) if record else (state, stats)
+            return finish(state, ser0, stats, mbuf)
 
         rows, counts = arrivals.rows[:n_ticks], arrivals.counts[:n_ticks]
         tick = jnp.int32(cfg.tick_ms)
@@ -936,13 +1034,15 @@ class Engine:
             return carry[0].t < t_end
 
         def body(carry):
-            s, stats, ser = carry
+            s, stats, ser, mb, cur = carry
             i = ((s.t - t0) // tick).astype(jnp.int32)  # tick index to run
             rows_i = jax.lax.dynamic_index_in_dim(rows, i, 0, keepdims=False)
             cnt_i = jax.lax.dynamic_index_in_dim(counts, i, 0, keepdims=False)
             sig0 = _quiescence_sig(s)
             s2 = self._tick(s, (rows_i, cnt_i), emit_io=False,
                             tick_indexed=True, params=params)[0]
+            if obs:  # the executed tick's sample, same tap as the dense scan
+                mb, cur = obs_device.tap_tick(mb, cur, s2, cfg.tick_ms)
             quiet = self.ex.alland(jnp.all(_quiescence_sig(s2) == sig0))
             # leap target: the clock of the next tick that must execute
             ev = jnp.minimum(
@@ -969,6 +1069,9 @@ class Engine:
             s3, rate = jax.lax.cond(
                 quiet, leap, lambda s: (s, jnp.zeros((C,), jnp.float32)), s2)
             s3 = s3.replace(t=new_t)
+            if obs:  # skipped ticks' samples in closed form (n_skip=0: id)
+                mb, cur = obs_device.tap_leap(mb, cur, s3, n_skip,
+                                              cfg.tick_ms)
             bucket = jnp.clip(jnp.floor(jnp.log2(jnp.maximum(
                 n_skip, 1).astype(jnp.float32))).astype(jnp.int32),
                 0, st.LEAP_BUCKETS - 1)
@@ -997,11 +1100,12 @@ class Engine:
                     avg_wait_ms=jnp.where(
                         skip_m[:, None], avg,
                         ser.avg_wait_ms).at[i].set(samp.avg_wait_ms))
-            return (s3, stats, ser)
+            return (s3, stats, ser, mb, cur)
 
-        state, stats, series = jax.lax.while_loop(
-            cond, body, (state, stats, ser0))
-        return (state, series, stats) if record else (state, stats)
+        cur0 = obs_device.cursor_of(state) if obs else None
+        state, stats, series, mbuf, _ = jax.lax.while_loop(
+            cond, body, (state, stats, ser0, mbuf, cur0))
+        return finish(state, series, stats, mbuf)
 
     def run_compressed_jit(self, donate: bool = False):
         """A jitted ``run_compressed`` (same donation contract as

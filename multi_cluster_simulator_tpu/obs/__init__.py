@@ -1,0 +1,29 @@
+"""obs/ — the zero-sync observability subsystem (ARCHITECTURE.md
+§observability).
+
+Three planes, all gated on the project's standing invariant — bitwise
+invisibility to replay:
+
+- **device metrics plane** (``obs/device.py``): an optional
+  ``MetricsBuffer`` pytree threaded through the scan carry — per-tick
+  counters read off ``SimState``, accumulated into fixed-shape on-device
+  rings and histogram buckets, harvested once per chunk at the existing
+  chunk boundary (one transfer per chunk, never per tick);
+- **profile plane** (``obs/profile.py``): ``jax.profiler``-native phase
+  annotation — named scopes on the 7 tick phases and TraceAnnotations
+  around every dispatch site — plus ``tools/profile_capture.py``;
+- **serving surface**: a Prometheus-text ``/metrics`` endpoint and
+  ``/healthz`` on the service hosts (services/lifecycle.py,
+  services/serving.py), backed by the harvested device rows bridged into
+  the existing OTLP ``Meter``; ``obs/promtext.py`` parses the exposition
+  back (tests + the CI scrape gate).
+"""
+
+from multi_cluster_simulator_tpu.obs.device import (  # noqa: F401
+    OBS_DEPTH_BUCKETS, OBS_RING, MetricsBuffer, TapCursor, cursor_of,
+    harvest, metrics_init, queue_depth, reduce_metrics, tap_leap,
+    tap_tick,
+)
+from multi_cluster_simulator_tpu.obs.profile import (  # noqa: F401
+    TICK_PHASES, annotate_dispatch, phase_scope,
+)

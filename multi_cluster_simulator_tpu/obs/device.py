@@ -1,0 +1,297 @@
+"""The device metrics plane: per-tick telemetry without host syncs.
+
+The host-side Tracer/Meter (services/telemetry.py) can only see what
+crosses the host boundary — inside a dispatch the engine is a black box.
+This module is the on-device half: a ``MetricsBuffer`` pytree rides the
+scan carry next to ``SimState``, a tap after every executed tick READS the
+state and accumulates deltas, depths, and histograms into fixed-shape
+buffers, and the whole buffer is harvested ONCE per chunk at the chunk
+boundary the drivers already cross — one transfer per chunk, never per
+tick (Blox, arxiv 2312.12621: schedulers live or die by their
+instrumentation surface; this one must not perturb the perf ladder it
+observes).
+
+Invariants, each load-bearing:
+
+- **Write-only-to-itself.** Taps read ``SimState`` leaves and write only
+  the buffer — never a state leaf (simlint rule family 9 ``obs-tap``
+  enforces it statically; ``bench.py --obs ab`` and tests/test_obs.py
+  prove obs-on == obs-off bit-identical on the final state).
+- **Exact under time compression.** A quiescent leap applies the skipped
+  ticks' samples in closed form (``tap_leap``) — per-tick deltas are zero
+  at a fixed point, per-tick levels replicate it, and the wait accrual
+  telescopes exactly as ``Engine._leap_local`` proves for the state — so
+  the compressed run's harvested buffer equals the dense run's bit for
+  bit. The one f32 leaf, ``wait_accrued``, shares the state's own
+  bit-parity domain (PARITY.md §time compression): n_skip per-tick adds
+  and one telescoped add agree exactly while the accrued values stay
+  integer-valued f32 below 2^24 ms — the same bound ``wait_total``
+  itself needs, so the buffer is never the weaker surface.
+- **Shard-safe carry.** Per-cluster leaves shard over the cluster axis
+  like the state; cross-cluster partials (the histogram, the ring value
+  rows) carry a leading shard axis of local size 1 so the buffer
+  round-trips shard_map chunk calls without double counting; the global
+  view reduces through ``parallel/exchange.py`` (``reduce_metrics``,
+  dispatched once per harvest by ``ShardedEngine.collect_metrics``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from multi_cluster_simulator_tpu.core.state import LEAP_BUCKETS, SimState
+
+# ring slots: the last OBS_RING ticks' per-tick samples (slot = tick
+# ordinal mod OBS_RING, so chunked runs address the ring consistently by
+# the virtual clock alone)
+OBS_RING = 64
+# log2 histogram of per-(tick, cluster) queue depth: bucket 0 = empty,
+# bucket b>=1 = depth in [2^(b-1), 2^b)
+OBS_DEPTH_BUCKETS = 16
+
+
+@struct.dataclass
+class MetricsBuffer:
+    """Fixed-shape on-device telemetry accumulators.
+
+    The per-tick deltas the taps accumulate are differences of CUMULATIVE
+    state counters (placed_total, arr_ptr, wait_total, ...); the previous
+    values ride a ``TapCursor`` that lives only INSIDE a run's scan carry
+    and is re-derived from the input state at every chunk entry
+    (``cursor_of`` — at a chunk boundary the cursor always equals the
+    incoming state's counters, so nothing needs to cross the boundary).
+    Keeping cursors out of this buffer is load-bearing: a cursor leaf
+    would be bitwise equal to a state leaf at the boundary, XLA would
+    alias the two output buffers, and the next DONATING dispatch would
+    reject the aliased buffer as already-donated (the serving tier hit
+    exactly that).
+
+    Leaves with a leading axis of 1 are shard-local partials (summed over
+    this shard's clusters); under a mesh they concatenate to
+    ``[n_shards, ...]`` and the global view is the axis-0 sum
+    (``reduce_metrics`` / host ``harvest``)."""
+
+    ticks: jax.Array  # [] i32 — ticks observed (leaps included)
+    # per-cluster accumulators
+    placed: jax.Array  # [C] i32 — placements this window
+    arrived: jax.Array  # [C] i32 — arrivals ingested
+    borrows: jax.Array  # [C] i32 — jobs newly hosted for peers
+    wait_accrued: jax.Array  # [C] f32 — wait-time accrued (ms)
+    ovf: jax.Array  # [C] i32 — narrow-store overflows surfaced
+    depth_sum: jax.Array  # [C] i32 — Σ per-tick queue depth
+    depth_max: jax.Array  # [C] i32
+    # shard-local partials (leading axis 1 = this shard)
+    depth_hist: jax.Array  # [1, B] i32 — log2 depth histogram
+    ring_placed: jax.Array  # [1, R] i32 — per-tick placed (local sum)
+    ring_depth: jax.Array  # [1, R] i32 — per-tick depth (local sum)
+    # replicated (identical on every shard)
+    ring_t: jax.Array  # [R] i32 — tick clock per ring slot (0 = unwritten)
+    # DRIVER provenance, not a replay metric: which leaps the compressed
+    # driver took (the dense driver takes none, so this is the one leaf
+    # excluded from the compressed==dense equality contract — everything
+    # else in the buffer must match bit for bit; tests/test_obs.py)
+    leap_hist: jax.Array  # [LEAP_BUCKETS] i32 — log2 leap sizes
+
+
+@struct.dataclass
+class TapCursor:
+    """The previous cumulative state counters a tap differences against.
+    Scan-carry-internal only (never crosses a jit boundary — see
+    MetricsBuffer's aliasing note); rebuild with ``cursor_of(state)`` at
+    every run entry."""
+
+    placed: jax.Array  # [C] i32 (placed_total)
+    arrived: jax.Array  # [C] i32 (arr_ptr)
+    lent: jax.Array  # [C] i32 (lent.count)
+    wait: jax.Array  # [C] f32 (wait_total)
+    ovf: jax.Array  # [C] i32 (narrow-store overflow total)
+
+
+def queue_depth(state: SimState) -> jax.Array:
+    """[C] total queued jobs (l0 + l1 + ready + wait; lent/borrowed track
+    ownership, not local backlog). THE canonical backlog definition —
+    the taps, the serving snapshot probe, and the per-request host's
+    gauge all call this one site, so the surfaces cannot silently
+    diverge if a queue tier is ever added."""
+    return (state.l0.count + state.l1.count + state.ready.count
+            + state.wait.count)
+
+
+def _ovf_total(state: SimState) -> jax.Array:
+    """[C] checked-narrow overflow total across the compact layouts (zeros
+    on the wide layout, which carries no counters)."""
+    total = jnp.zeros_like(state.arr_ptr)
+    for part in (state.l0, state.l1, state.ready, state.wait, state.lent,
+                 state.borrowed, state.run):
+        if hasattr(part, "ovf"):
+            total = total + part.ovf
+    return total
+
+
+def metrics_init(state: SimState) -> MetricsBuffer:
+    """A zeroed buffer shaped for ``state``'s cluster axis — build once,
+    thread through every chunk call. Pure jnp (safe inside jit; the
+    drivers call it host-side once)."""
+    C = state.arr_ptr.shape[0]
+    zi = jnp.zeros((C,), jnp.int32)
+    return MetricsBuffer(
+        ticks=jnp.int32(0),
+        placed=zi, arrived=zi, borrows=zi,
+        wait_accrued=jnp.zeros((C,), jnp.float32),
+        ovf=zi, depth_sum=zi, depth_max=zi,
+        depth_hist=jnp.zeros((1, OBS_DEPTH_BUCKETS), jnp.int32),
+        ring_placed=jnp.zeros((1, OBS_RING), jnp.int32),
+        ring_depth=jnp.zeros((1, OBS_RING), jnp.int32),
+        ring_t=jnp.zeros((OBS_RING,), jnp.int32),
+        leap_hist=jnp.zeros((LEAP_BUCKETS,), jnp.int32),
+    )
+
+
+def cursor_of(state: SimState) -> TapCursor:
+    """The tap cursor for a run starting at ``state`` — called at run
+    entry; the counters only move inside ticks, so at a chunk boundary
+    this reconstructs exactly the cursor the previous chunk's last tick
+    left behind."""
+    return TapCursor(placed=state.placed_total, arrived=state.arr_ptr,
+                     lent=state.lent.count, wait=state.wait_total,
+                     ovf=_ovf_total(state))
+
+
+def _depth_buckets(depth: jax.Array) -> jax.Array:
+    """log2 bucket per cluster: 0 for empty, else 1 + floor(log2(depth))."""
+    b = 1 + jnp.floor(jnp.log2(jnp.maximum(depth, 1).astype(
+        jnp.float32))).astype(jnp.int32)
+    return jnp.clip(jnp.where(depth > 0, b, 0), 0, OBS_DEPTH_BUCKETS - 1)
+
+
+def tap_tick(mbuf: MetricsBuffer, cur: TapCursor, state: SimState,
+             tick_ms: int) -> tuple[MetricsBuffer, TapCursor]:
+    """Accumulate one executed tick's sample — READS the post-tick state,
+    writes only the buffer + cursor (the obs-tap contract)."""
+    placed_d = state.placed_total - cur.placed
+    arrived_d = state.arr_ptr - cur.arrived
+    lent_d = jnp.maximum(state.lent.count - cur.lent, 0)
+    ovf_now = _ovf_total(state)
+    depth = queue_depth(state)
+    slot = (state.t // jnp.int32(tick_ms)) % OBS_RING
+    mbuf = mbuf.replace(
+        ticks=mbuf.ticks + 1,
+        placed=mbuf.placed + placed_d,
+        arrived=mbuf.arrived + arrived_d,
+        borrows=mbuf.borrows + lent_d,
+        wait_accrued=mbuf.wait_accrued + (state.wait_total - cur.wait),
+        ovf=mbuf.ovf + (ovf_now - cur.ovf),
+        depth_sum=mbuf.depth_sum + depth,
+        depth_max=jnp.maximum(mbuf.depth_max, depth),
+        depth_hist=mbuf.depth_hist.at[0, _depth_buckets(depth)].add(1),
+        ring_placed=mbuf.ring_placed.at[0, slot].set(
+            jnp.sum(placed_d).astype(jnp.int32)),
+        ring_depth=mbuf.ring_depth.at[0, slot].set(
+            jnp.sum(depth).astype(jnp.int32)),
+        ring_t=mbuf.ring_t.at[slot].set(state.t),
+    )
+    cur = TapCursor(placed=state.placed_total, arrived=state.arr_ptr,
+                    lent=state.lent.count, wait=state.wait_total,
+                    ovf=ovf_now)
+    return mbuf, cur
+
+
+def tap_leap(mbuf: MetricsBuffer, cur: TapCursor, state: SimState,
+             n_skip: jax.Array, tick_ms: int
+             ) -> tuple[MetricsBuffer, TapCursor]:
+    """The skipped-tick samples of a quiescent leap, in closed form —
+    exactly what ``n_skip`` dense ``tap_tick`` calls over the fixed point
+    would have accumulated. ``state`` is the POST-leap state (clock at the
+    landing tick, wait accrual applied); ``n_skip=0`` is the identity, so
+    the compressed driver calls this unconditionally after the leap cond.
+
+    Per-tick deltas (placed/arrived/borrows/ovf) are zero at a fixed
+    point, so only the cursors that moved (the closed-form wait accrual)
+    advance; per-tick levels replicate: depth_sum += n_skip·depth, the
+    histogram bucket of the fixed depth gains n_skip, and each covered
+    ring slot takes the LATEST skipped tick that maps to it (slot j keeps
+    ordinal q = m + n_skip - ((m + n_skip - j) mod R), covered iff
+    q > m) — bitwise what the dense writes leave behind."""
+    depth = queue_depth(state)
+    tick = jnp.int32(tick_ms)
+    m = (state.t // tick) - n_skip  # ordinal of the executed tick
+    j = jnp.arange(OBS_RING, dtype=jnp.int32)
+    q = m + n_skip - ((m + n_skip - j) % OBS_RING)
+    covered = jnp.logical_and(n_skip > 0, q > m)
+    depth_tot = jnp.sum(depth).astype(jnp.int32)
+    lbucket = jnp.clip(jnp.floor(jnp.log2(jnp.maximum(
+        n_skip, 1).astype(jnp.float32))).astype(jnp.int32),
+        0, LEAP_BUCKETS - 1)
+    mbuf = mbuf.replace(
+        ticks=mbuf.ticks + n_skip,
+        wait_accrued=mbuf.wait_accrued + (state.wait_total - cur.wait),
+        depth_sum=mbuf.depth_sum + n_skip * depth,
+        depth_max=jnp.maximum(mbuf.depth_max, depth),
+        depth_hist=mbuf.depth_hist.at[0, _depth_buckets(depth)].add(n_skip),
+        ring_placed=jnp.where(covered[None, :], 0, mbuf.ring_placed),
+        ring_depth=jnp.where(covered[None, :], depth_tot, mbuf.ring_depth),
+        ring_t=jnp.where(covered, q * tick, mbuf.ring_t),
+        leap_hist=mbuf.leap_hist.at[lbucket].add(
+            (n_skip > 0).astype(jnp.int32)),
+    )
+    return mbuf, cur.replace(wait=state.wait_total)
+
+
+def reduce_metrics(mbuf: MetricsBuffer, ex) -> MetricsBuffer:
+    """Cross-shard reduction of the shard-local partials through the
+    sanctioned exchange (parallel/exchange.py): the histogram and ring
+    value rows are per-shard sums over local clusters, so the global view
+    is one ``allsum`` each. Per-cluster leaves are already globally
+    correct (sharded like the state); replicated leaves (ticks, ring_t,
+    leap_hist) are identical on every shard by construction. Called once
+    per harvest — never inside the carry, where a second reduction would
+    double count."""
+    return mbuf.replace(
+        depth_hist=ex.allsum(mbuf.depth_hist),
+        ring_placed=ex.allsum(mbuf.ring_placed),
+        ring_depth=ex.allsum(mbuf.ring_depth),
+    )
+
+
+def harvest(mbuf: MetricsBuffer) -> dict:
+    """Host-side readout of one harvested buffer — the single coercion per
+    chunk boundary (np.array, owned copies: the buffer leaves may share a
+    donated dispatch's allocator). Returns JSON-ready totals plus the raw
+    per-cluster rows under ``per_cluster``."""
+    leaves = {k: np.array(getattr(mbuf, k))
+              for k in mbuf.__dataclass_fields__}
+    ticks = int(leaves["ticks"])
+    depth_sum = int(leaves["depth_sum"].sum())
+    hist = leaves["depth_hist"].sum(axis=0)
+    nz = np.flatnonzero(hist)
+    lh = leaves["leap_hist"]
+    lnz = np.flatnonzero(lh)
+    # ring rows in clock order, unwritten slots dropped
+    order = np.argsort(leaves["ring_t"], kind="stable")
+    rt = leaves["ring_t"][order]
+    valid = rt > 0
+    return {
+        "ticks": ticks,
+        "placed": int(leaves["placed"].sum()),
+        "arrived": int(leaves["arrived"].sum()),
+        "borrows": int(leaves["borrows"].sum()),
+        "wait_accrued_ms": round(float(leaves["wait_accrued"].sum()), 3),
+        "narrow_ovf": int(leaves["ovf"].sum()),
+        "queue_depth_mean": round(depth_sum / max(ticks, 1), 3),
+        "queue_depth_max": int(leaves["depth_max"].max(initial=0)),
+        "depth_hist_log2": hist[:nz[-1] + 1].tolist() if len(nz) else [],
+        "leap_hist_log2": lh[:lnz[-1] + 1].tolist() if len(lnz) else [],
+        "ring": {
+            "t_ms": rt[valid].tolist(),
+            "placed": leaves["ring_placed"].sum(axis=0)[order][valid].tolist(),
+            "queue_depth":
+                leaves["ring_depth"].sum(axis=0)[order][valid].tolist(),
+        },
+        "per_cluster": {
+            "placed": leaves["placed"].tolist(),
+            "queue_depth_max": leaves["depth_max"].tolist(),
+        },
+    }

@@ -1,0 +1,79 @@
+"""The profile plane: jax.profiler-native phase + dispatch annotation.
+
+Two primitives, both free when no profiler session is active:
+
+- ``phase_scope(name)`` — a ``jax.named_scope`` over one of the 7 tick
+  phases (``TICK_PHASES``). Named scopes attach op metadata at TRACE time
+  (zero runtime cost, no numerics impact — the bit-identity matrix pins
+  that), so every HLO op in a captured trace carries its phase and a
+  per-phase cost breakdown falls out of any ``jax.profiler`` capture.
+- ``annotate_dispatch(name)`` — a ``jax.profiler.TraceAnnotation`` for the
+  HOST side of a dispatch site (the bench chunk loop, the serving drive
+  thread, the tournament grid call, the env step loop): the wall-time
+  spans a trace viewer aligns the device stream against.
+
+``tools/profile_capture.py`` drives both: it wraps a bench-shaped run in
+``start_trace``/``stop_trace`` and emits the per-phase cost table from the
+engine's phase-prefix ablation (``Engine.run_prefix``) — superseding the
+old hand-copied ``tools/phase_probe.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+# The documented determinization of the reference's concurrent goroutines
+# (core/engine.py module docstring; PARITY.md §phase order). Order matters:
+# phase k of the ablation driver (Engine.run_prefix) runs phases [1..k].
+TICK_PHASES = (
+    "release",   # 1. completions + finished-foreign returns
+    "expire",    # 2. virtual-node expiry (sane mode only)
+    "ingest",    # 3. arrivals -> Level0 / ReadyQueue
+    "schedule",  # 4. the policy zoo's scheduling pass
+    "borrow",    # 5. cross-cluster borrow matching
+    "snapshot",  # 6. trader state snapshot
+    "trade",     # 7. trader market round
+)
+
+
+def phase_scope(name: str):
+    """Named scope for one tick phase — ops lowered inside it carry
+    ``tick.<name>`` in their metadata (visible in any profiler capture and
+    in HLO dumps). Pure trace-time metadata: no runtime cost, no effect on
+    the compiled program's numerics."""
+    return jax.named_scope(f"tick.{name}")
+
+
+def annotate_dispatch(name: str, **kwargs):
+    """Host-side TraceAnnotation around a dispatch site (shows up as a
+    named span on the host thread's profiler track). A no-op context when
+    no profiler session is active; falls back to a nullcontext where the
+    profiler is unavailable entirely (minimal jaxlib builds)."""
+    try:
+        return jax.profiler.TraceAnnotation(f"mcs.dispatch.{name}", **kwargs)
+    except Exception:  # pragma: no cover - profiler-less jaxlib
+        return contextlib.nullcontext()
+
+
+def start_trace(logdir: str) -> None:
+    """Start a jax profiler capture into ``logdir`` (TensorBoard layout:
+    ``plugins/profile/<ts>/*.xplane.pb`` + ``.trace.json.gz``)."""
+    jax.profiler.start_trace(logdir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+def trace_artifacts(logdir: str) -> list[str]:
+    """The capture files a finished trace session left under ``logdir``
+    (what tools/profile_capture.py --quick asserts non-empty)."""
+    import os
+
+    out = []
+    for root, _dirs, files in os.walk(logdir):
+        out.extend(os.path.join(root, f) for f in files
+                   if f.endswith((".xplane.pb", ".trace.json.gz")))
+    return sorted(out)

@@ -59,6 +59,21 @@ def _arr_specs(axis: str):
                     dur=shard, n=shard)
 
 
+def _metrics_specs(axis: str):
+    """MetricsBuffer placement (obs/device.py): per-cluster leaves shard
+    with the state; the shard-local partials (histogram + ring value rows)
+    shard on their leading size-1-per-shard axis so the buffer round-trips
+    chunk calls without double counting; ticks/ring_t/leap_hist are
+    replicated (identical on every shard by construction)."""
+    from multi_cluster_simulator_tpu.obs.device import MetricsBuffer
+    shard, rep = P(axis), P()
+    return MetricsBuffer(
+        ticks=rep, placed=shard, arrived=shard, borrows=shard,
+        wait_accrued=shard, ovf=shard, depth_sum=shard, depth_max=shard,
+        depth_hist=P(axis, None), ring_placed=P(axis, None),
+        ring_depth=P(axis, None), ring_t=rep, leap_hist=rep)
+
+
 def _tick_arr_specs(axis: str):
     """TickArrivals shard on the cluster axis (axis 1; axis 0 is ticks)."""
     return TickArrivals(rows=P(None, axis), counts=P(None, axis))
@@ -112,9 +127,51 @@ class ShardedEngine:
                  else _arr_specs(self.axis))
         return _device_put_tree(arrivals, specs, self.mesh, place)
 
+    def shard_metrics(self, mbuf):
+        """Place a host-built MetricsBuffer (obs.metrics_init) onto the
+        mesh with the carry placement ``run_fn(with_metrics=True)``
+        expects. The shard-local partial leaves (leading axis 1 on one
+        device) expand to one row per shard — row 0 keeps the incoming
+        partial, new rows are zero, so totals are preserved whether the
+        buffer is fresh or mid-run."""
+        import numpy as np
+        n = self.mesh.shape[self.axis]
+
+        def widen(leaf):
+            a = np.asarray(leaf)
+            pad = np.zeros((n - a.shape[0],) + a.shape[1:], a.dtype)
+            return np.concatenate([a, pad], axis=0)
+
+        mbuf = mbuf.replace(depth_hist=widen(mbuf.depth_hist),
+                            ring_placed=widen(mbuf.ring_placed),
+                            ring_depth=widen(mbuf.ring_depth))
+        return _device_put_tree(mbuf, _metrics_specs(self.axis), self.mesh)
+
+    def collect_metrics(self, mbuf):
+        """The global view of a sharded MetricsBuffer carry: the
+        shard-local partials reduce through the sanctioned exchange
+        (``obs.reduce_metrics`` -> ``ex.allsum``) and the per-cluster
+        leaves gather — ONE device program per harvest, one transfer of
+        the reduced buffer (the obs plane's chunk-boundary contract under
+        sharding)."""
+        from multi_cluster_simulator_tpu.obs.device import reduce_metrics
+        ex = self.engine.ex
+        _PER_CLUSTER = ("placed", "arrived", "borrows", "wait_accrued",
+                        "ovf", "depth_sum", "depth_max")
+
+        def body(mb):
+            mb = reduce_metrics(mb, ex)  # partials -> replicated allsums
+            return mb.replace(**{k: ex.gather(getattr(mb, k))
+                                 for k in _PER_CLUSTER})
+
+        mapped = _shard_map(body, mesh=self.mesh,
+                            in_specs=(_metrics_specs(self.axis),),
+                            out_specs=P(), **_SHARD_MAP_KW)
+        return jax.jit(mapped)(mbuf)
+
     def run_fn(self, n_ticks: int, tick_indexed: bool = False,
                donate: bool = False, time_compress: bool = False,
-               with_params: bool = False):
+               with_params: bool = False, with_metrics: bool = False):
         """A jitted (state, arrivals) -> state advancing n_ticks under
         shard_map (``(state, MetricSample)`` when cfg.record_metrics: the
         [T, C] series stays cluster-sharded on its second axis).
@@ -130,17 +187,24 @@ class ShardedEngine:
         and a replicated ``LeapStats`` is appended to the outputs.
         ``with_params=True`` adds a third argument — a replicated
         ``PolicyParams`` pytree selecting the policy per call (the
-        policy-as-data axis; every shard must receive the same cell)."""
+        policy-as-data axis; every shard must receive the same cell).
+        ``with_metrics=True`` adds a trailing MetricsBuffer argument
+        (place with ``shard_metrics``) and appends the updated buffer
+        LAST to the outputs — a shard-local CARRY; reduce a harvest view
+        with ``collect_metrics``."""
         eng = self.engine
         if time_compress and not tick_indexed:
             raise ValueError("time_compress requires tick_indexed "
                              "(pre-bucketed TickArrivals)")
 
-        def body(state, arrivals, params=None):
+        def body(state, arrivals, *rest):
+            params = rest[0] if with_params else None
+            mbuf = rest[-1] if with_metrics else None
             if time_compress:
                 return eng.run_compressed(state, arrivals, n_ticks,
-                                          params=params)
-            return eng.run(state, arrivals, n_ticks, params=params)
+                                          params=params, mbuf=mbuf)
+            return eng.run(state, arrivals, n_ticks, params=params,
+                           mbuf=mbuf)
 
         out_specs = _state_specs(self.axis)
         if self.cfg.record_metrics:
@@ -155,11 +219,20 @@ class ShardedEngine:
                 out_specs = out_specs + (stats_spec,)
             else:
                 out_specs = (out_specs, stats_spec)
+        if with_metrics:
+            # the buffer is a CARRY: it comes back shard-local (the same
+            # placement it went in with) and only collect_metrics reduces
+            mspec = _metrics_specs(self.axis)
+            out_specs = (out_specs + (mspec,)
+                         if isinstance(out_specs, tuple)
+                         else (out_specs, mspec))
         arr_specs = (_tick_arr_specs(self.axis) if tick_indexed
                      else _arr_specs(self.axis))
         in_specs = (_state_specs(self.axis), arr_specs)
         if with_params:
             in_specs = in_specs + (P(),)  # params replicated on every shard
+        if with_metrics:
+            in_specs = in_specs + (_metrics_specs(self.axis),)
         mapped = _shard_map(
             body, mesh=self.mesh,
             in_specs=in_specs,

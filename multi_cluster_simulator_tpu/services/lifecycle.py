@@ -53,6 +53,24 @@ class Service:
     def register_handlers(self) -> None:
         """Install routes on self.httpd (RegisterHandlers analogue)."""
 
+    def health(self) -> tuple[bool, dict]:
+        """Liveness verdict for ``/healthz``: (healthy, detail). Subclasses
+        override to check their background loops (the serving tier's pacer
+        and drive threads, the per-request host's tick loop) — a service
+        whose loops have died must flip the surface to 503, not keep
+        answering 200 off a wedged core."""
+        return True, {}
+
+    def _handle_healthz(self, body: bytes, headers: dict):
+        import json
+        ok, detail = self.health()
+        payload = {"status": "ok" if ok else "unhealthy",
+                   "service": self.name, **detail}
+        return (200 if ok else 503), json.dumps(payload).encode()
+
+    def _handle_metrics(self, body: bytes, headers: dict):
+        return 200, self.meter.render_prometheus().encode()
+
     def on_start(self) -> None:
         """Start background loops (tick threads, monitors)."""
 
@@ -70,6 +88,12 @@ class Service:
     def start(self) -> None:
         if self._started:
             return
+        # default observability surface on EVERY service host: /healthz
+        # (the health() hook) and a Prometheus-text /metrics off the
+        # Meter. Registered before register_handlers so a subclass route
+        # wins if it needs to specialize either path.
+        self.httpd.route("GET", "/healthz", self._handle_healthz)
+        self.httpd.route("GET", "/metrics", self._handle_metrics)
         self.register_handlers()
         self.httpd.start()
         self.meter.start_exporter()

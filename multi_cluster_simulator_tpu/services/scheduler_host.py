@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Optional
 
@@ -128,6 +129,7 @@ class SchedulerService(Service):
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix=f"{name}-io")
         self.ticks_run = 0
+        self._last_tick_wall: Optional[float] = None  # tick-loop liveness
         # Restore here, in __init__ — before Service.start() brings the
         # HTTP surface up — so no acknowledged mutation can ever precede
         # (and be clobbered by) the state swap.
@@ -180,8 +182,8 @@ class SchedulerService(Service):
         self.httpd.route("POST", "/borrow", self._handle_borrow)
         self.httpd.route("POST", "/lent", self._handle_lent)
         self.httpd.route("GET", "/newClient", self._handle_new_client)
-        self.httpd.route("GET", "/metrics",
-                         lambda b, h: (200, self.meter.render_prometheus().encode()))
+        # /metrics + /healthz come from the Service defaults
+        # (lifecycle.py); health() below watches the tick loop
 
     def _handle_submit_fifo(self, body: bytes, headers: dict):
         """POST / — submit to the ReadyQueue (server.go:23-51) *regardless
@@ -405,6 +407,11 @@ class SchedulerService(Service):
             self._grpc_server, self.grpc_addr = rpc.start_server(
                 [rpc.resource_channel_handler(self, cadence_s, self._stop)],
                 port=self.grpc_port)
+        # anchor the /healthz recency check at loop start: without this,
+        # a tick thread wedged inside its very FIRST device call would
+        # never set the timestamp and the None-guard would skip the
+        # recency check forever — alive-but-stuck reporting 200
+        self._last_tick_wall = time.time()
         self._tick_thread = threading.Thread(target=self._tick_loop,
                                              daemon=True,
                                              name=f"{self.name}-tick")
@@ -510,15 +517,28 @@ class SchedulerService(Service):
                 self._journal = None
             t = int(np.asarray(self.state.t))
         self.ticks_run += 1
+        self._last_tick_wall = time.time()
         if (self.checkpoint_path is not None
                 and self.ticks_run % self.checkpoint_period_ticks == 0):
             self._save_checkpoint()
         # waitTime histogram on the reference's 5 s metric cadence
-        # (metrics.go:19-30)
+        # (metrics.go:19-30), plus the state gauges the /metrics surface
+        # serves (tick-thread-side under the lock the read needs anyway —
+        # never a handler-path device sync)
         if t % 5_000 == 0:
             with self._slock:
                 self.meter.record("waitTime",
                                   float(np.asarray(st.avg_wait_ms(self.state))[0]))
+                self.meter.set_gauge(
+                    "placed_total",
+                    float(np.asarray(self.state.placed_total)[0]))
+                from multi_cluster_simulator_tpu.obs.device import (
+                    queue_depth,
+                )
+                self.meter.set_gauge(
+                    "queue_depth",
+                    float(np.asarray(queue_depth(self.state))[0]))
+        self.meter.set_gauge("ticks_run", float(self.ticks_run))
         self._process_returns(io)
         self._process_borrow(io)
 
@@ -658,6 +678,25 @@ class SchedulerService(Service):
             return s2, ok
 
         return self._mutate(op, replay=replay)
+
+    def health(self) -> tuple[bool, dict]:
+        """/healthz verdict for the per-request host: the tick loop (the
+        Go scheduler's Run goroutine equivalent) must be alive AND
+        actually ticking — a loop thread wedged on a device call stays
+        is_alive() forever, so recency is the real check (10 tick periods
+        of slack covers a slow dispatch; the loop's own exception guard
+        already keeps transient tick failures from killing it)."""
+        checks = {}
+        if self._started:
+            checks["tick_thread_alive"] = (self._tick_thread is not None
+                                           and self._tick_thread.is_alive())
+            period = self.cfg.tick_ms / 1000.0 / self.speed
+            if self._last_tick_wall is not None:
+                lag = time.time() - self._last_tick_wall
+                checks["tick_loop_ticking"] = lag < max(10 * period, 2.0)
+                checks["last_tick_s_ago"] = round(lag, 3)
+        ok = all(v for v in checks.values() if isinstance(v, bool))
+        return ok, {**checks, "ticks_run": self.ticks_run}
 
     # -- introspection for tests/operators --
     def stats(self) -> dict:

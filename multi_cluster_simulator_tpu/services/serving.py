@@ -61,6 +61,8 @@ from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
 from multi_cluster_simulator_tpu.core import state as st
 from multi_cluster_simulator_tpu.core.engine import Engine, round_up_pow2
 from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.obs import device as obs_device
+from multi_cluster_simulator_tpu.obs.profile import annotate_dispatch
 from multi_cluster_simulator_tpu.ops import fields as F
 from multi_cluster_simulator_tpu.ops import queues as Q
 from multi_cluster_simulator_tpu.services import host_ops
@@ -142,7 +144,8 @@ class ServingScheduler(Service):
                  window: int = 16, k_cap: int = 128,
                  max_staged: Optional[int] = None, pacer: bool = True,
                  snapshot_every: int = 1, track_latency: bool = False,
-                 warm_k=(1,), **kw):
+                 warm_k=(1,), obs: bool = True,
+                 snapshot_max_age_ms: Optional[float] = None, **kw):
         super().__init__(name, registry_url=registry_url, speed=speed, **kw)
         self.specs = list(specs)
         self.cfg = cfg
@@ -156,6 +159,24 @@ class ServingScheduler(Service):
         self.track_latency = track_latency
         self.warm_k = tuple(warm_k)
         self._warm_sorted = tuple(sorted(set(int(k) for k in warm_k)))
+        self.obs = bool(obs)
+        # Snapshot freshness bound (the staleness bugfix): snapshot_age_ms
+        # was always REPORTED but never BOUNDED — a wedged refresh thread
+        # kept serving arbitrarily stale /stats with 200s. Under the pacer
+        # the drive loop refreshes on the dispatch cadence even with zero
+        # traffic (the pacer seals ticks off wall time alone), so a
+        # snapshot older than many windows means the loop is wedged and
+        # queries answer 503 + the age (counted as stale_503) instead of
+        # silently stale data. Deterministic drivers (pacer=False) pace
+        # refreshes themselves, so the bound defaults off there; pass an
+        # explicit value to arm it anyway (the staleness test does).
+        if snapshot_max_age_ms is not None:
+            self.snapshot_max_age_ms = float(snapshot_max_age_ms)
+        elif pacer:
+            self.snapshot_max_age_ms = max(
+                20.0 * self.window * cfg.tick_ms / speed, 2_000.0)
+        else:
+            self.snapshot_max_age_ms = None
         self.engine = Engine(cfg)
         # the device state has ONE owner — the drive thread (or the
         # deterministic driver): handlers never read or write it, so no
@@ -164,6 +185,14 @@ class ServingScheduler(Service):
         # across leaves, which a donating dispatch may not receive twice
         import jax.numpy as jnp
         self._state = jax.tree.map(jnp.copy, init_state(cfg, self.specs))
+        # the device metrics plane: one MetricsBuffer rides every run_io
+        # dispatch (same single owner as the state — the drive thread) and
+        # is harvested at the snapshot refresh, the sync point the loop
+        # already pays; its gauges bridge into self.meter so /metrics and
+        # the OTLP export report identical numbers
+        self._mbuf = (obs_device.metrics_init(self._state) if self.obs
+                      else None)
+        self._obs_harvest: dict = {}
         self._run_io = self.engine.run_io_jit(donate=True)
         self._delay_policy = cfg.policy is not PolicyKind.FIFO
         # staging: one open bucket per cluster for the current tick, a
@@ -224,8 +253,9 @@ class ServingScheduler(Service):
         self.httpd.route("GET", "/stats", self._handle_stats)
         self.httpd.route("GET", "/quote", self._handle_quote)
         self.httpd.route("GET", "/placed", self._handle_placed)
-        self.httpd.route("GET", "/metrics",
-                         lambda b, h: (200, self.meter.render_prometheus().encode()))
+        # /metrics and /healthz ride the Service defaults (lifecycle.py):
+        # the Prometheus render off the bridged Meter, and this service's
+        # health() verdict below
 
     def _handle_submit_fifo(self, body: bytes, headers: dict):
         """POST / — the reference's ReadyQueue endpoint (server.go:23-51),
@@ -293,10 +323,33 @@ class ServingScheduler(Service):
             return 503, self._quote(rejected, reasons, accepted, depth)
         return 200, json.dumps({"Accepted": accepted}).encode()
 
+    def _stale_503(self, age_ms: float):
+        """A query against a snapshot past the freshness bound: 503 with
+        the age, never a 200 off arbitrarily stale data (the staleness
+        bugfix — a wedged refresh loop used to serve forever)."""
+        self.meter.add("stale_503", 1)
+        return 503, json.dumps({
+            "Error": "snapshot stale — refresh loop wedged?",
+            "SnapshotAgeMs": round(age_ms, 3),
+            "SnapshotMaxAgeMs": self.snapshot_max_age_ms,
+            "RetryAfterMs": round(self._retry_quote_ms(), 3)}).encode()
+
+    def _fresh_snap(self):
+        """(snapshot, None) when within the freshness bound, else
+        (None, age_ms). Handlers answer queries only off a fresh view."""
+        s = self._snap
+        age = s.age_ms()
+        if (self.snapshot_max_age_ms is not None
+                and age > self.snapshot_max_age_ms):
+            return None, age
+        return s, None
+
     def _handle_stats(self, body: bytes, headers: dict):
         """GET /stats — constellation totals from the latest snapshot
         (never the device)."""
-        s = self._snap
+        s, stale_age = self._fresh_snap()
+        if s is None:
+            return self._stale_503(stale_age)
         return 200, json.dumps({
             "t_ms": s.sim_t, "stage_t_ticks": s.stage_t,
             "placed_total": s.placed, "running": int(s.running.sum()),
@@ -313,7 +366,9 @@ class ServingScheduler(Service):
         c = self._query_int(headers, "cluster", 0)
         if not (0 <= c < self.C):
             return 400, None
-        s = self._snap
+        s, stale_age = self._fresh_snap()
+        if s is None:
+            return self._stale_503(stale_age)
         return 200, json.dumps({
             "cluster": c,
             "wait_quote_ms": round(float(s.avg_wait_ms[c])
@@ -329,7 +384,9 @@ class ServingScheduler(Service):
         jid = self._query_int(headers, "id", -1)
         if not (0 <= c < self.C):
             return 400, None
-        s = self._snap
+        s, stale_age = self._fresh_snap()
+        if s is None:
+            return self._stale_503(stale_age)
         return 200, json.dumps({
             "cluster": c, "id": jid, "status": s.job_status(c, jid),
             "snapshot_age_ms": round(s.age_ms(), 3)}).encode()
@@ -548,7 +605,12 @@ class ServingScheduler(Service):
                 if lst:
                     counts[ti, c] = len(lst)
                     rows[ti, c, :len(lst)] = np.asarray(lst, np.int32)
-        self._state, io = self._run_io(self._state, rows, counts)
+        with annotate_dispatch("serving", ticks=T, jobs=n_jobs):
+            if self.obs:
+                self._state, io, self._mbuf = self._run_io(
+                    self._state, rows, counts, None, self._mbuf)
+            else:
+                self._state, io = self._run_io(self._state, rows, counts)
         self.ticks_dispatched += T
         self.dispatches += 1
         self.batch_jobs.append(n_jobs)
@@ -556,6 +618,9 @@ class ServingScheduler(Service):
         self._batch_sum += n_jobs
         self._batch_max = max(self._batch_max, n_jobs)
         self.chunk_k.add(K)
+        # coalesce batch-size distribution on the wire-telemetry surface
+        # (drive-thread-side: never a handler cost)
+        self.meter.record("coalesce_batch_jobs", float(n_jobs))
         if self.cfg.borrowing:
             # host visibility of the cross-cluster events (the TickIO
             # side-channel): counted into telemetry; the in-batch borrow
@@ -587,7 +652,7 @@ class ServingScheduler(Service):
         """The snapshot's derived reads as ONE jitted program (scalars and
         [C] vectors; the id columns are raw leaves read directly)."""
         import jax.numpy as jnp
-        qd = (s.l0.count + s.l1.count + s.ready.count + s.wait.count)
+        qd = obs_device.queue_depth(s)
         drops = jnp.stack([
             jnp.sum(getattr(s.drops, k)).astype(jnp.int32) for k in
             ServingScheduler._DROP_KEYS])
@@ -623,6 +688,7 @@ class ServingScheduler(Service):
             run_ids=np.array(s.run.id),
             run_active=np.array(s.run.active),
             dispatches=self.dispatches)
+        prev = self._snap
         with self._stage_lock:
             # the unseen decrement and the snapshot swap are ONE atomic
             # step: dispatched jobs leave the admission bound's unseen set
@@ -636,10 +702,67 @@ class ServingScheduler(Service):
                                   staged_jobs=self._staged_jobs, **payload)
         self.visibility_log.append((self.ticks_dispatched,
                                     payload["wall"]))
+        self._bridge_meter(prev)
+
+    def _bridge_meter(self, prev: Optional[Snapshot]) -> None:
+        """Bridge the refreshed snapshot + the harvested device metrics
+        into the OTLP Meter (the one metrics store): the Prometheus
+        /metrics route and the Go-wire OTLP export both render from it,
+        so the two surfaces report identical numbers for the same window.
+        Runs on the refresh thread, off the request path; the harvest is
+        the plane's one chunk-boundary transfer (the refresh already
+        synced the same dispatch)."""
+        s = self._snap
+        m = self.meter
+        m.set_gauge("placed_total", float(s.placed))
+        m.set_gauge("queue_depth", float(s.queue_depth.sum()))
+        m.set_gauge("running", float(s.running.sum()))
+        m.set_gauge("staged_jobs", float(s.staged_jobs))
+        m.set_gauge("dispatches", float(s.dispatches))
+        m.set_gauge("ticks_dispatched", float(self.ticks_dispatched))
+        m.set_gauge("rejected_503", float(self._rejected_count()))
+        m.set_gauge("sim_t_ms", float(s.sim_t))
+        if prev is not None:
+            # the retiring snapshot's final age — how stale queries could
+            # have seen the surface this window (gauge + distribution)
+            age = (s.wall - prev.wall) * 1000.0
+            m.set_gauge("snapshot_age_ms", round(age, 3))
+            m.record("snapshot_age_ms_hist", age)
+        if self.obs and self._mbuf is not None:
+            h = obs_device.harvest(self._mbuf)
+            self._obs_harvest = h
+            m.set_gauge("obs_ticks", float(h["ticks"]))
+            m.set_gauge("obs_placed", float(h["placed"]))
+            m.set_gauge("obs_arrived", float(h["arrived"]))
+            m.set_gauge("obs_queue_depth_max", float(h["queue_depth_max"]))
+            m.set_gauge("obs_wait_accrued_ms", float(h["wait_accrued_ms"]))
+            m.set_gauge("obs_narrow_ovf", float(h["narrow_ovf"]))
 
     @property
     def snapshot(self) -> Snapshot:
         return self._snap
+
+    def health(self) -> tuple[bool, dict]:
+        """/healthz verdict: the pacer and drive threads must be alive
+        (pacer mode) and the snapshot within its freshness bound — a dead
+        loop or a wedged refresh flips the surface to 503 while the HTTP
+        server itself still answers (the whole point: the transport
+        outliving the core must be VISIBLE)."""
+        checks = {}
+        if self.pacer and self._started:
+            checks["pacer_alive"] = (self._pacer_thread is not None
+                                     and self._pacer_thread.is_alive())
+            checks["drive_alive"] = (self._drive_thread is not None
+                                     and self._drive_thread.is_alive())
+        age = self._snap.age_ms() if self._snap is not None else None
+        if self.snapshot_max_age_ms is not None and age is not None:
+            checks["snapshot_fresh"] = age <= self.snapshot_max_age_ms
+        ok = all(checks.values())
+        detail = dict(checks)
+        if age is not None:
+            detail["snapshot_age_ms"] = round(age, 3)
+        detail["dispatches"] = self.dispatches
+        return ok, detail
 
     def warmup(self, ks=None) -> None:
         """Precompile the (window, K) dispatch executables on a throwaway
@@ -654,7 +777,11 @@ class ServingScheduler(Service):
                 (self.window, self.C, int(K), Q.NF)).copy()
             counts = np.zeros((self.window, self.C), np.int32)
             clone = jax.tree.map(jnp.copy, self._state)
-            out, _io = self._run_io(clone, rows, counts)
+            if self.obs:  # warm the executable shape the live path calls
+                mb = jax.tree.map(jnp.copy, self._mbuf)
+                out, _io, _mb = self._run_io(clone, rows, counts, None, mb)
+            else:
+                out, _io = self._run_io(clone, rows, counts)
             jax.block_until_ready(out.t)  # compile-only: clone discarded
 
     # ------------------------------------------------------------------
@@ -672,6 +799,39 @@ class ServingScheduler(Service):
                 name=f"{self.name}-drive")
             self._pacer_thread.start()
             self._drive_thread.start()
+
+    def quiesce(self) -> None:
+        """Stop the pacer/drive loops while the HTTP surface keeps
+        serving (maintenance drain): every sealed tick is dispatched,
+        the snapshot refreshed once, and from then on queries/metrics
+        answer off a frozen core. /healthz flips unhealthy — a quiesced
+        service is deliberately not live.
+
+        The final flush only runs once BOTH loops have provably exited:
+        a drive thread wedged past the join timeout still owns the
+        donated device state, and dispatching from this thread too would
+        make two concurrent owners (donated-buffer reuse, acked jobs
+        lost) — exactly the wedge /healthz exists to surface, so raise
+        it instead of racing it."""
+        self._stop.set()
+        for th in (self._pacer_thread, self._drive_thread):
+            if th is not None:
+                th.join(timeout=30)
+                if th.is_alive():
+                    raise RuntimeError(
+                        f"quiesce: {th.name} did not exit within 30s — "
+                        "the loop is wedged (it still owns the device "
+                        "state, so no drain flush can run); /healthz is "
+                        "reporting it")
+        self._pacer_thread = None
+        self._drive_thread = None
+        self.dispatch_sealed()
+        self._refresh_snapshot()
+        # a deliberately frozen core is not a wedged refresh loop: the
+        # final snapshot above is the drained truth and stays servable,
+        # so disarm the staleness bound (health() still reports the
+        # service not-live via the dead-loop checks)
+        self.snapshot_max_age_ms = None
 
     def on_shutdown(self) -> None:
         self._stop.set()
@@ -758,6 +918,9 @@ class ServingScheduler(Service):
                 if self.batch_jobs else 0},
             "ragged_k": sorted(self.chunk_k),
             "rejected_503": self._rejected_count(),
+            "obs": ({k: v for k, v in self._obs_harvest.items()
+                     if k not in ("per_cluster", "ring")}
+                    if self._obs_harvest else None),
         }
 
     def state_host(self):

@@ -46,6 +46,7 @@ import contextvars
 import json
 import logging
 import os
+import re
 import secrets
 import threading
 import time
@@ -425,6 +426,19 @@ class Tracer:
             channel.close()
 
 
+# Prometheus metric names admit only [a-zA-Z0-9_:] — service names here
+# are dashed ("serve-tput"), which OTLP accepts but the exposition format
+# does not. This is the standard OTLP->Prometheus name translation
+# (invalid chars -> "_"), applied ONLY at the exposition rendering; the
+# OTLP export keeps the original name (pinned by tests/test_tracing.py).
+_PROM_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_metric_name(name: str) -> str:
+    n = _PROM_NAME_BAD.sub("_", name)
+    return ("_" + n) if n[:1].isdigit() else n
+
+
 class Meter:
     """Counters + histograms with periodic export.
 
@@ -447,9 +461,10 @@ class Meter:
         if self.otlp is not None:  # exports would actually use it
             _check_otlp_protocol(self.otlp_protocol)
         self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
         self._hists: dict[str, list[int]] = {}
         self._hist_sum: dict[str, float] = {}
-        self._lock = threading.Lock()  # guards: _counters, _hists, _hist_sum, _thread, _channel
+        self._lock = threading.Lock()  # guards: _counters, _gauges, _hists, _hist_sum, _thread, _channel
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._channel = None  # lazily-built long-lived gRPC channel
@@ -458,6 +473,15 @@ class Meter:
         """Up/down counter add (Int64UpDownCounter.Add)."""
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Absolute gauge set (ObservableGauge analogue) — the bridge the
+        device metrics plane uses: the serving tier's snapshot refresh
+        writes the harvested device rows here, so the Prometheus /metrics
+        surface and the OTLP export render the SAME numbers from the same
+        store (tests/test_obs.py pins the two surfaces equal)."""
+        with self._lock:
+            self._gauges[name] = value
 
     def record(self, name: str, value: float) -> None:
         """Histogram record (Float64Histogram.Record)."""
@@ -471,6 +495,7 @@ class Meter:
         with self._lock:
             return {"service": self.service, "time": time.time(),
                     "counters": dict(self._counters),
+                    "gauges": dict(self._gauges),
                     "histograms": {k: {"buckets": list(v),
                                        "sum": self._hist_sum.get(k, 0.0),
                                        "bounds": list(self._BOUNDS)}
@@ -479,17 +504,23 @@ class Meter:
     def render_prometheus(self) -> str:
         """Prometheus exposition text (for a /metrics route), conformant
         with # HELP/# TYPE lines: counters here are up/down (OTel
-        Int64UpDownCounter) so they expose as gauges; histograms expose
-        cumulative le-buckets."""
+        Int64UpDownCounter) so they expose as gauges, absolute gauges
+        (set_gauge) expose as gauges, histograms as cumulative
+        le-buckets."""
         snap = self.snapshot()
         lines = []
         for k, v in snap["counters"].items():
-            full = f"{self.service}_{k}"
+            full = prom_metric_name(f"{self.service}_{k}")
             lines.append(f"# HELP {full} up/down counter {k} of {self.service}")
             lines.append(f"# TYPE {full} gauge")
             lines.append(f"{full} {v}")
+        for k, v in snap["gauges"].items():
+            full = prom_metric_name(f"{self.service}_{k}")
+            lines.append(f"# HELP {full} gauge {k} of {self.service}")
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {v}")
         for k, h in snap["histograms"].items():
-            full = f"{self.service}_{k}"
+            full = prom_metric_name(f"{self.service}_{k}")
             lines.append(f"# HELP {full} histogram {k} of {self.service}")
             lines.append(f"# TYPE {full} histogram")
             acc = 0
@@ -513,6 +544,9 @@ class Meter:
                 "dataPoints": [{"asDouble": v, "timeUnixNano": now}],
                 "aggregationTemporality": 2,  # CUMULATIVE
                 "isMonotonic": False}})
+        for k, v in snap["gauges"].items():
+            metrics.append({"name": f"{self.service}_{k}", "gauge": {
+                "dataPoints": [{"asDouble": v, "timeUnixNano": now}]}})
         for k, h in snap["histograms"].items():
             metrics.append({"name": f"{self.service}_{k}", "histogram": {
                 "dataPoints": [{

@@ -1,0 +1,47 @@
+"""obs-tap violations, one per shape the rule must catch: a tap that
+stores into SimState via .replace, a tap that index-updates a state leaf,
+a host coercion of traced state inside a tap, and a Python float() over a
+traced buffer value."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class MetricsBuffer:
+    ticks: object
+    placed: object
+
+
+def tap_store_replace(mbuf, state):
+    # VIOLATION: telemetry writing simulation state
+    state = state.replace(placed_total=state.placed_total + 1)
+    return mbuf.replace(ticks=mbuf.ticks + 1), state
+
+
+def tap_store_at(mbuf, state):
+    # VIOLATION: index-update into a state leaf
+    bumped = state.jobs_in_queue.at[0].add(1)
+    _ = bumped
+    return mbuf
+
+
+def tap_host_coerce(mbuf, state, tick_ms):
+    # VIOLATION: host coercion of traced state inside the tick scan
+    depth = np.asarray(state.l0.count)
+    return mbuf.replace(placed=mbuf.placed + int(depth.sum()))
+
+
+def tap_float_sync(mbuf, state):
+    # VIOLATION: Python coercion of a traced parameter
+    rate = float(mbuf.ticks)
+    return mbuf.replace(ticks=mbuf.ticks + jnp.int32(rate))
+
+
+def tap_device_get(mbuf, state):
+    # VIOLATION: explicit device readback inside a tap
+    host = jax.device_get(state.placed_total)
+    _ = host
+    return mbuf

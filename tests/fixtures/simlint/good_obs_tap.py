@@ -1,0 +1,39 @@
+"""Clean obs-tap fixture: a metric tap that READS SimState leaves and
+writes only its own MetricsBuffer — the legal idiom (obs/device.py)."""
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class MetricsBuffer:
+    ticks: object
+    placed: object
+    depth_hist: object
+
+
+def _queue_depth(state):
+    return state.l0.count + state.ready.count
+
+
+def tap_tick(mbuf, cur, state, tick_ms):
+    depth = _queue_depth(state)
+    bucket = jnp.clip(depth, 0, 15)
+    mbuf = mbuf.replace(
+        ticks=mbuf.ticks + 1,
+        placed=mbuf.placed + (state.placed_total - cur),
+        depth_hist=mbuf.depth_hist.at[0, bucket].add(1),
+    )
+    return mbuf, state.placed_total
+
+
+def reduce_metrics(mbuf, ex):
+    return mbuf.replace(depth_hist=ex.allsum(mbuf.depth_hist))
+
+
+def harvest(mbuf):
+    # host-side helper: takes only the buffer, so it is OUT of tap scope
+    # and the coercion is legal
+    import numpy as np
+
+    return {"ticks": int(np.asarray(mbuf.ticks))}

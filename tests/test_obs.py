@@ -1,0 +1,417 @@
+"""The observability subsystem (obs/, ARCHITECTURE.md §observability):
+the device metrics plane must be bitwise invisible to replay — obs-on
+final state == obs-off across the parity matrix, composed with the
+compact layout, time compression, the ragged chunk pipeline, and the
+8-device mesh — while its harvested buffer is exact (compressed ==
+dense), the serving surface's /metrics scrape parses and matches the
+OTLP Meter's values, and /healthz flips unhealthy when a serving loop
+dies or the snapshot goes stale."""
+
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from multi_cluster_simulator_tpu.core.engine import (
+    Engine, pack_arrivals_by_tick, pack_arrivals_chunks,
+)
+from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.obs import device as D
+from multi_cluster_simulator_tpu.obs.promtext import (
+    PromParseError, parse_prometheus, scalar_samples,
+)
+from tests.test_pipeline import (
+    TC_TICKS, TICK_MS, _assert_trees_equal, _bursty_arrivals, _cfg, _specs,
+    _tc_scenarios,
+)
+
+N_TICKS = 20
+CHUNKS = [10, 10]
+
+
+def _assert_mbuf_equal(a, b, exclude=("leap_hist",)):
+    """Bitwise buffer equality; ``leap_hist`` is driver provenance (the
+    dense driver takes no leaps) and is excluded by default. Shard-local
+    partial leaves compare on their shard-sum (the global quantity)."""
+    for k in a.__dataclass_fields__:
+        if k in exclude:
+            continue
+        x, y = np.asarray(getattr(a, k)), np.asarray(getattr(b, k))
+        if k in ("depth_hist", "ring_placed", "ring_depth"):
+            x, y = x.sum(axis=0), y.sum(axis=0)
+        np.testing.assert_array_equal(x, y, err_msg=k)
+
+
+def _run_obs(eng, state, ta, n_ticks):
+    mb0 = D.metrics_init(state)
+    return jax.jit(eng.run, static_argnums=(2,))(state, ta, n_ticks, None,
+                                                 mb0)
+
+
+# --------------------------------------------------------------------------
+# bit-identity across the parity matrix (+ compressed==dense exactness)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(_tc_scenarios()))
+def test_obs_invisible_and_exact_across_matrix(name):
+    """The tentpole pin, per scenario (DELAY parity/blocked/wave+trader,
+    FFD, FIFO+borrowing): (1) obs-on final state AND metric series equal
+    obs-off bit for bit; (2) the compressed driver's harvested buffer
+    equals the dense driver's bit for bit (skipped-tick closed form)."""
+    cfg, arr, specs = _tc_scenarios()[name]
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    eng = Engine(cfg)
+    ref, ref_ser = eng.run_jit()(init_state(cfg, specs), ta, TC_TICKS)
+    out, ser, mb = _run_obs(eng, init_state(cfg, specs), ta, TC_TICKS)
+    _assert_trees_equal(ref, out)
+    _assert_trees_equal(ref_ser, ser)
+
+    out_c, ser_c, stats, mb_c = jax.jit(
+        eng.run_compressed, static_argnums=(2,))(
+        init_state(cfg, specs), ta, TC_TICKS, None,
+        D.metrics_init(init_state(cfg, specs)))
+    _assert_trees_equal(ref, out_c)
+    _assert_trees_equal(ref_ser, ser_c)
+    _assert_mbuf_equal(mb, mb_c)
+    assert int(np.asarray(stats.ticks_executed)) < TC_TICKS, \
+        "compression never leapt — vacuous exactness test"
+    h = D.harvest(mb)
+    assert h["ticks"] == TC_TICKS
+    assert h["placed"] == int(np.asarray(ref.placed_total).sum())
+
+
+def test_obs_composed_with_compact_layout():
+    """The taps read only layout-shared accessors, so the plane composes
+    with the compact SoA state: obs-on == obs-off on the compact state,
+    and the harvested buffer is identical wide-vs-compact."""
+    from multi_cluster_simulator_tpu.core.compact import derive_plan
+
+    cfg, arr, specs = _cfg(), _bursty_arrivals(), _specs(3)
+    ta = pack_arrivals_by_tick(arr, N_TICKS, TICK_MS)
+    plan = derive_plan(cfg, specs, arr)
+    eng = Engine(cfg)
+    ref_c = eng.run_jit()(init_state(cfg, specs, plan=plan), ta, N_TICKS)
+    out_c, mb_compact = _run_obs(eng, init_state(cfg, specs, plan=plan),
+                                 ta, N_TICKS)
+    _assert_trees_equal(ref_c, out_c)
+    _out_w, mb_wide = _run_obs(eng, init_state(cfg, specs), ta, N_TICKS)
+    _assert_mbuf_equal(mb_wide, mb_compact)
+
+
+def test_obs_chunked_carry_matches_single_run():
+    """The buffer is a CARRY: threading it across ragged chunk calls
+    (with the cursor re-derived from the incoming state at each chunk
+    entry) must equal one unchunked run — the chunk boundary is where
+    the cursor reconstruction could silently skew deltas."""
+    cfg, arr, specs = _cfg(), _bursty_arrivals(), _specs(3)
+    eng = Engine(cfg)
+    ta = pack_arrivals_by_tick(arr, N_TICKS, TICK_MS)
+    _ref, mb_one = _run_obs(eng, init_state(cfg, specs), ta, N_TICKS)
+
+    parts = pack_arrivals_chunks(arr, CHUNKS, TICK_MS)
+    s = init_state(cfg, specs)
+    mb = D.metrics_init(s)
+    fn = jax.jit(eng.run, static_argnums=(2,))
+    for part, n in zip(parts, CHUNKS):
+        s, mb = fn(s, part, n, None, mb)
+    _assert_trees_equal(_ref, s)
+    _assert_mbuf_equal(mb_one, mb, exclude=())
+
+
+def test_obs_sharded_mesh_matches_single_device():
+    """8-device mesh: the sharded carry (per-cluster leaves sharded,
+    partials on a per-shard row) plus the exchange-reduced collect equal
+    the single-device run bit for bit."""
+    from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
+
+    C = 8
+    cfg, specs, arr = _cfg(), _specs(C), _bursty_arrivals(C)
+    ta = pack_arrivals_by_tick(arr, N_TICKS, TICK_MS)
+    eng = Engine(cfg)
+    ref, mb_ref = _run_obs(eng, init_state(cfg, specs), ta, N_TICKS)
+
+    sh = ShardedEngine(cfg, make_mesh(8))
+    out, mb_sh = sh.run_fn(N_TICKS, tick_indexed=True, with_metrics=True)(
+        sh.shard_state(init_state(cfg, specs)), sh.shard_arrivals(ta),
+        sh.shard_metrics(D.metrics_init(init_state(cfg, specs))))
+    _assert_trees_equal(ref, out)
+    _assert_mbuf_equal(mb_ref, sh.collect_metrics(mb_sh), exclude=())
+
+
+def test_run_prefix_full_equals_run():
+    """The profile plane's phase-prefix ablation hook: phase_limit=7 is
+    the whole tick, so its scan must equal ``run`` bit for bit (guards
+    the phase-gating refactor of the tick body)."""
+    cfg, arr, specs = _cfg(), _bursty_arrivals(), _specs(3)
+    ta = pack_arrivals_by_tick(arr, N_TICKS, TICK_MS)
+    eng = Engine(cfg)
+    ref = eng.run_jit()(init_state(cfg, specs), ta, N_TICKS)
+    out = jax.jit(eng.run_prefix, static_argnums=(2, 3))(
+        init_state(cfg, specs), ta, N_TICKS, 7)
+    _assert_trees_equal(ref, out)
+
+
+def test_obs_harvest_contents():
+    """Harvest totals tie back to the state: placed/arrived equal the
+    run's counters, the ring's trailing slots carry the last ticks'
+    clocks, and the depth histogram accounts every (tick, cluster)."""
+    cfg, arr, specs = _cfg(), _bursty_arrivals(), _specs(3)
+    ta = pack_arrivals_by_tick(arr, N_TICKS, TICK_MS)
+    eng = Engine(cfg)
+    out, mb = _run_obs(eng, init_state(cfg, specs), ta, N_TICKS)
+    h = D.harvest(mb)
+    assert h["placed"] == int(np.asarray(out.placed_total).sum())
+    assert h["arrived"] == int(np.asarray(out.arr_ptr).sum())
+    assert h["ticks"] == N_TICKS
+    assert sum(h["depth_hist_log2"]) == N_TICKS * len(specs)
+    assert h["ring"]["t_ms"][-1] == N_TICKS * TICK_MS
+    assert len(h["ring"]["t_ms"]) == min(N_TICKS, D.OBS_RING)
+
+
+# --------------------------------------------------------------------------
+# prometheus exposition parser
+# --------------------------------------------------------------------------
+
+def test_promtext_roundtrip_and_strictness():
+    from multi_cluster_simulator_tpu.services.telemetry import (
+        Meter, prom_metric_name,
+    )
+
+    m = Meter("svc-x", otlp_endpoint="")
+    m.add("jobs_submitted", 3)
+    m.set_gauge("queue_depth", 7.5)
+    m.record("waitTime", 42.0)
+    parsed = parse_prometheus(m.render_prometheus())
+    flat = scalar_samples(parsed)
+    assert flat[prom_metric_name("svc-x_jobs_submitted")] == 3
+    assert flat[prom_metric_name("svc-x_queue_depth")] == 7.5
+    hist = parsed[prom_metric_name("svc-x_waitTime") + "_bucket"]
+    assert hist[(("le", "50"),)] == 1.0
+    # metric names must be exposition-legal even for dashed service names
+    for name in parsed:
+        assert "-" not in name
+    with pytest.raises(PromParseError):
+        parse_prometheus("this is ! not a sample\n")
+    with pytest.raises(PromParseError):
+        parse_prometheus('ok_metric{bad-label="x"} 1\n')
+
+
+# --------------------------------------------------------------------------
+# serving surface: /metrics == OTLP, /healthz, snapshot staleness
+# --------------------------------------------------------------------------
+
+def serving_cfg():
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+
+    return SimConfig(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                     queue_capacity=64, max_running=128, max_arrivals=64,
+                     max_ingest_per_tick=16, max_nodes=5,
+                     max_virtual_nodes=0)
+
+
+def _mk_serving(**kw):
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.services.serving import ServingScheduler
+
+    C = kw.pop("C", 2)
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    kw.setdefault("pacer", False)
+    kw.setdefault("window", 2)
+    kw.setdefault("warm_k", (4,))
+    kw.setdefault("k_cap", 16)
+    kw.setdefault("max_staged", 4096)
+    return ServingScheduler(kw.pop("name", "svc-obs"), specs, serving_cfg(),
+                            **kw)
+
+
+def test_serving_metrics_scrape_matches_otlp_meter():
+    """The serving surface contract: the /metrics scrape parses, the core
+    gauges are present/nonzero, and every value equals the OTLP Meter
+    export for the same window (both render from one bridged store)."""
+    from multi_cluster_simulator_tpu.services import httpd
+    from multi_cluster_simulator_tpu.services.telemetry import (
+        prom_metric_name,
+    )
+
+    s = _mk_serving(name="svc-obs-scrape")
+    s.start()
+    try:
+        for t in range(4):
+            for c in range(2):
+                assert s.submit_direct(c, 100 + t * 10 + c, 1, 100, 1_500)
+            s.seal_tick()
+        s.dispatch_sealed()
+        code, text = httpd.get(s.url + "/metrics")
+        assert code == 200
+        flat = scalar_samples(parse_prometheus(text.decode()))
+        otlp = {}
+        for rm in s.meter.otlp_payload()["resourceMetrics"]:
+            for sm in rm["scopeMetrics"]:
+                for m in sm["metrics"]:
+                    arm = m.get("sum") or m.get("gauge")
+                    if arm:
+                        otlp[m["name"]] = arm["dataPoints"][0]["asDouble"]
+        core = ["placed_total", "queue_depth", "obs_ticks", "obs_placed",
+                "dispatches"]
+        for k in core:
+            name = f"svc-obs-scrape_{k}"
+            assert name in otlp, f"{name} missing from OTLP"
+            assert prom_metric_name(name) in flat, f"{name} missing from scrape"
+            assert otlp[name] == flat[prom_metric_name(name)], name
+        assert flat[prom_metric_name("svc-obs-scrape_obs_placed")] == 8
+        assert flat[prom_metric_name("svc-obs-scrape_obs_ticks")] == 4
+    finally:
+        s.shutdown()
+
+
+def test_serving_device_plane_rides_dispatches():
+    """The device buffer accumulates across run_io dispatches and its
+    harvest matches the snapshot's ground truth."""
+    s = _mk_serving(name="svc-obs-acc")
+    s.start()
+    try:
+        jid = 0
+        for t in range(6):
+            for c in range(2):
+                jid += 1
+                assert s.submit_direct(c, jid, 1, 100, 1_000)
+            s.seal_tick()
+            s.dispatch_sealed()  # window-spanning: multiple dispatches
+        h = s._obs_harvest
+        assert h["ticks"] == 6
+        assert h["placed"] == s.snapshot.placed == jid
+        assert h["arrived"] == jid
+    finally:
+        s.shutdown()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_serving_healthz_flips_when_drive_thread_dies():
+    """/healthz must answer 200 while the loops run and 503 once the
+    drive thread dies (here: a dispatch that raises kills the loop, the
+    transport outliving the core)."""
+    from multi_cluster_simulator_tpu.services import httpd
+
+    s = _mk_serving(name="svc-obs-health", pacer=True, speed=500.0)
+    s.start()
+    orig_dispatch = s._dispatch
+    try:
+        code, body = httpd.get(s.url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+
+        def boom(T):
+            raise RuntimeError("injected drive-loop death")
+
+        s._dispatch = boom  # next sealed window kills the drive thread
+        deadline = time.time() + 30
+        while s._drive_thread.is_alive() and time.time() < deadline:
+            time.sleep(0.01)
+        assert not s._drive_thread.is_alive(), "drive thread survived"
+        code, body = httpd.get(s.url + "/healthz")
+        d = json.loads(body)
+        assert code == 503, d
+        assert d["status"] == "unhealthy" and d["drive_alive"] is False
+        assert d["pacer_alive"] is True
+    finally:
+        # restore the real dispatch so shutdown's final flush can drain
+        # the sealed backlog (a consuming stub would spin forever)
+        s._dispatch = orig_dispatch
+        s.shutdown()
+
+
+def test_serving_healthz_unhealthy_after_quiesce():
+    from multi_cluster_simulator_tpu.services import httpd
+
+    s = _mk_serving(name="svc-obs-quiesce", pacer=True, speed=500.0)
+    s.start()
+    try:
+        assert httpd.get(s.url + "/healthz")[0] == 200
+        s.quiesce()
+        code, body = httpd.get(s.url + "/healthz")
+        assert code == 503 and json.loads(body)["status"] == "unhealthy"
+        # the frozen surface still serves queries off the last snapshot
+        assert httpd.get(s.url + "/stats")[0] == 200
+    finally:
+        s.shutdown()
+
+
+def test_serving_stale_snapshot_answers_503_with_age():
+    """The staleness bugfix, pinned with a frozen refresher: a snapshot
+    past snapshot_max_age_ms flips every query endpoint to 503 + the
+    age (counted as stale_503); a refresh restores 200."""
+    from multi_cluster_simulator_tpu.services import httpd
+
+    s = _mk_serving(name="svc-obs-stale", snapshot_max_age_ms=80.0)
+    s.start()
+    try:
+        assert s.submit_direct(0, 1, 1, 100, 1_000)
+        s.seal_tick()
+        s.dispatch_sealed()  # refreshes: queries fresh now
+        assert httpd.get(s.url + "/stats")[0] == 200
+        time.sleep(0.15)  # the refresher is frozen (no pacer, no driver)
+        for ep in ("/stats", "/quote?cluster=0", "/placed?cluster=0&id=1"):
+            code, body = httpd.get(s.url + ep)
+            d = json.loads(body)
+            assert code == 503, (ep, d)
+            assert d["SnapshotAgeMs"] > 80.0
+            assert d["RetryAfterMs"] > 0
+        assert s.meter.snapshot()["counters"]["stale_503"] == 3
+        ok, detail = s.health()
+        assert not ok and detail["snapshot_fresh"] is False
+        s._refresh_snapshot()
+        assert httpd.get(s.url + "/stats")[0] == 200
+    finally:
+        s.shutdown()
+
+
+def test_scheduler_host_healthz_watches_tick_loop():
+    """The per-request host's /healthz: 200 with a live ticking loop,
+    503 once the loop thread is gone (dead-thread simulation)."""
+    from multi_cluster_simulator_tpu.config import SimConfig
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.services import httpd
+    from multi_cluster_simulator_tpu.services.scheduler_host import (
+        SchedulerService,
+    )
+
+    cfg = SimConfig(n_res=2, max_nodes=5, max_virtual_nodes=0,
+                    queue_capacity=16, max_running=16, max_arrivals=16)
+    s = SchedulerService("sched-health", uniform_cluster(1, 5), cfg,
+                         speed=1000.0, grpc_port=None)
+    s.start()
+    try:
+        deadline = time.time() + 30
+        while s.ticks_run == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        code, body = httpd.get(s.url + "/healthz")
+        d = json.loads(body)
+        assert code == 200 and d["tick_thread_alive"], d
+        assert d["ticks_run"] > 0
+        # kill the loop: a dead tick thread must flip the verdict
+        s._stop.set()
+        s._tick_thread.join(timeout=10)
+        code, body = httpd.get(s.url + "/healthz")
+        assert code == 503, body
+        assert json.loads(body)["tick_thread_alive"] is False
+    finally:
+        s.shutdown()
+
+
+def test_every_service_host_exposes_the_default_surface():
+    """The Service base wires /healthz + /metrics on every host — spot
+    check a host that never registered either route itself."""
+    from multi_cluster_simulator_tpu.services import httpd
+    from multi_cluster_simulator_tpu.services.lifecycle import Service
+
+    s = Service("svc-base")
+    s.start()
+    try:
+        assert httpd.get(s.url + "/healthz")[0] == 200
+        code, text = httpd.get(s.url + "/metrics")
+        assert code == 200
+        parse_prometheus(text.decode())  # must parse (may be empty)
+    finally:
+        s.shutdown()

@@ -358,15 +358,15 @@ def test_serve_sync_reaches_the_real_serving_tier(tmp_path):
     rule must fire — so the package analyzing clean can never mean
     'checked nothing'."""
     src = (PKG_DIR / "services" / "serving.py").read_text()
-    anchor = '''    def _handle_stats(self, body: bytes, headers: dict):
-        """GET /stats — constellation totals from the latest snapshot
-        (never the device)."""
-        s = self._snap
+    anchor = '''        s, stale_age = self._fresh_snap()
+        if s is None:
+            return self._stale_503(stale_age)
+        return 200, json.dumps({
 '''
     bad = src.replace(
         anchor,
-        anchor + "        depth = int(np.asarray("
-                 "self._state.jobs_in_queue)[0])\n", 1)
+        "        depth = int(np.asarray("
+        "self._state.jobs_in_queue)[0])\n" + anchor, 1)
     assert bad != src, "anchor moved; update this test"
     f = tmp_path / "serving_bad.py"
     f.write_text(bad)
@@ -560,3 +560,74 @@ def test_detects_injected_engine_regression(tmp_path):
     f = tmp_path / "engine_bad.py"
     f.write_text(bad)
     assert any(x.rule == "purity-traced-branch" for x in run(str(f)))
+
+
+# --------------------------------------------------------------------------
+# rule family 9: obs-tap (device metrics plane read-only discipline)
+# --------------------------------------------------------------------------
+
+def test_bad_obs_tap_flags_every_violation_shape():
+    """The fixture carries five shapes — a ``state.replace`` store, a
+    ``.at[...].add`` index-update into a state leaf, an np.asarray of
+    traced state inside a tap, a Python float() over a traced buffer
+    value, and an explicit jax.device_get — and each must surface as its
+    own obs-tap finding."""
+    findings = [f for f in run(str(FIXTURES / "bad_obs_tap.py"))
+                if f.rule == "obs-tap"]
+    assert len(findings) == 5, "\n".join(f.render() for f in findings)
+
+
+def test_good_obs_tap_fixture_is_clean():
+    """The paired clean tap — state reads, buffer-only writes, the
+    buffer's own ``.at`` updates, an exchange reduction, and a host-side
+    harvest helper that takes only the buffer — must NOT trip obs-tap
+    (or anything else)."""
+    findings = run(str(FIXTURES / "good_obs_tap.py"))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    proc = _cli(str(FIXTURES / "good_obs_tap.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_obs_tap_reaches_the_real_tap_module(tmp_path):
+    """obs-tap provably engages with obs/device.py's real tap: paste a
+    jnp store into sim state into a copy of the module and the rule must
+    fire — so the package analyzing clean can never mean 'checked
+    nothing' (the injected-regression contract every family carries)."""
+    src = (PKG_DIR / "obs" / "device.py").read_text()
+    anchor = "    placed_d = state.placed_total - cur.placed\n"
+    bad = src.replace(
+        anchor,
+        anchor
+        + "    state = state.replace(\n"
+        "        placed_total=state.placed_total.at[0].add(1))\n", 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "device_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "obs-tap" for x in run(str(f)))
+
+
+def test_obs_tap_flags_host_coercion_in_real_tap(tmp_path):
+    """The jit-scope half of the rule against the real module: an
+    np.asarray of the traced state inside tap_tick must fire."""
+    src = (PKG_DIR / "obs" / "device.py").read_text()
+    anchor = "    depth = queue_depth(state)\n"
+    bad = src.replace(
+        anchor,
+        "    import numpy as np2\n"
+        "    depth = _queue_depth(state)\n"
+        "    _host = np2.asarray(state.jobs_in_queue)\n", 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "device_bad_coerce.py"
+    f.write_text(bad)
+    assert any(x.rule == "obs-tap" for x in run(str(f)))
+
+
+def test_obs_tap_scopes_the_obs_package():
+    """The family actually runs over obs/ inside the package (a clean
+    result must mean 'checked and clean', not 'not in scope')."""
+    from tools.simlint.runner import OBS_TAP_DIRS
+
+    modules, _ = load_target(str(PKG_DIR))
+    tops = {m.relpath.split("/", 1)[0] for m in modules if m.relpath}
+    assert set(OBS_TAP_DIRS) <= tops, \
+        "obs/ not loaded — the obs-tap scope is empty"

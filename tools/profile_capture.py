@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Profile capture: a jax.profiler trace around a bench-shaped run plus a
+per-phase cost table from the engine's own phase-prefix ablation.
+
+Supersedes the old ``tools/phase_probe.py`` (which timed hand-copied phase
+closures that silently rotted as the engine evolved): the ablation here
+runs the REAL tick body truncated after the first k phases
+(``Engine.run_prefix`` with ``phase_limit=k`` — obs.profile.TICK_PHASES
+order), so phase k's cost at shape is wall(prefix k) - wall(prefix k-1) on
+whatever config is being profiled, policies and trader included. The
+trace capture is orthogonal: phases inside the tick are named scopes
+(``tick.<phase>``), so the .xplane.pb/.trace.json.gz artifact attributes
+device time per phase in any trace viewer; the dispatch sites are
+TraceAnnotations on the host track.
+
+Usage:
+  python -m tools.profile_capture --config headline --quick --out DIR
+  python -m tools.profile_capture --config delay --ticks 200 --no-trace
+
+Exit is nonzero if the per-phase table is empty/NaN or (unless --no-trace)
+the trace session produced no artifact — the CI smoke gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _build(config: str, quick: bool):
+    """(cfg, specs, arrivals, n_ticks) for a profile shape. These mirror
+    bench.py's configs at profile-friendly scale — the point is the REAL
+    tick structure (policy pass, trader on/off), not a record."""
+    from multi_cluster_simulator_tpu.config import (
+        PolicyKind, SimConfig, TraderConfig,
+    )
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+    if config == "headline":
+        C = 256 if quick else 4096
+        cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=8,
+                        max_running=32, max_arrivals=250,
+                        max_ingest_per_tick=8, parity=True, n_res=2,
+                        max_nodes=5, max_virtual_nodes=0)
+        specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+        arrivals = uniform_stream(C, 250, 1_500_000, max_cores=8,
+                                  max_mem=6_000, max_dur_ms=60_000, seed=9)
+    elif config == "delay":
+        C = 64 if quick else 512
+        cfg = SimConfig(policy=PolicyKind.DELAY, queue_capacity=64,
+                        max_running=128, max_arrivals=250, parity=True,
+                        n_res=2, max_nodes=5, max_virtual_nodes=0)
+        specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+        arrivals = uniform_stream(C, 250, 1_500_000, max_cores=8,
+                                  max_mem=6_000, max_dur_ms=60_000, seed=9)
+    elif config == "trader":
+        C = 16 if quick else 64
+        cfg = SimConfig(policy=PolicyKind.DELAY, queue_capacity=64,
+                        max_running=128, max_arrivals=250, parity=False,
+                        n_res=3, max_nodes=5, max_virtual_nodes=4,
+                        trader=TraderConfig(enabled=True))
+        specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+        arrivals = uniform_stream(C, 250, 1_500_000, max_cores=8,
+                                  max_mem=6_000, max_dur_ms=60_000, seed=9)
+    else:
+        raise SystemExit(f"unknown --config {config}")
+    return cfg, specs, arrivals
+
+
+def phase_table(cfg, specs, arrivals, n_ticks: int, repeats: int = 3):
+    """Per-phase ms/tick via cumulative phase-prefix ablation over the
+    real tick body. Returns [{phase, cum_ms_per_tick, ms_per_tick,
+    fraction}] in TICK_PHASES order, inactive phases (trader off, no
+    borrowing) included at ~0 by construction."""
+    import jax
+
+    from multi_cluster_simulator_tpu.core.engine import (
+        Engine, pack_arrivals_by_tick,
+    )
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.obs.profile import TICK_PHASES
+
+    eng = Engine(cfg)
+    state0 = init_state(cfg, specs)
+    ta = pack_arrivals_by_tick(arrivals, n_ticks, cfg.tick_ms)
+
+    def timed(limit):
+        fn = jax.jit(eng.run_prefix, static_argnums=(2, 3))
+        out = jax.block_until_ready(fn(state0, ta, n_ticks, limit))  # compile
+        walls = []
+        for _ in range(repeats):
+            t0 = time.time()
+            out = fn(state0, ta, n_ticks, limit)
+            np.asarray(out.t)  # force a host read inside the timer
+            walls.append(time.time() - t0)
+        return min(walls) / n_ticks * 1e3  # ms/tick
+
+    cum = [timed(k) for k in range(len(TICK_PHASES) + 1)]  # k=0: carry only
+    full = cum[-1]
+    rows = []
+    for i, name in enumerate(TICK_PHASES):
+        per = cum[i + 1] - cum[i]
+        rows.append({"phase": name,
+                     "cum_ms_per_tick": round(cum[i + 1], 4),
+                     "ms_per_tick": round(per, 4),
+                     "fraction": round(per / full, 4) if full > 0 else 0.0})
+    rows.append({"phase": "(carry/clock)", "cum_ms_per_tick": round(cum[0], 4),
+                 "ms_per_tick": round(cum[0], 4),
+                 "fraction": round(cum[0] / full, 4) if full > 0 else 0.0})
+    return rows, full
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="headline",
+                    choices=("headline", "delay", "trader"))
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes (the CI smoke)")
+    ap.add_argument("--ticks", type=int, default=None,
+                    help="ticks per timed scan (default 50 quick / 400)")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--out", default=None, metavar="DIR",
+                    help="trace + table output dir "
+                         "(default ./profile_capture)")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the jax.profiler capture; only the table")
+    args = ap.parse_args()
+
+    import jax
+
+    from multi_cluster_simulator_tpu.core.engine import (
+        Engine, pack_arrivals_by_tick,
+    )
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.obs import profile as prof
+
+    n_ticks = args.ticks or (50 if args.quick else 400)
+    out_dir = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "profile_capture")
+    os.makedirs(out_dir, exist_ok=True)
+    cfg, specs, arrivals = _build(args.config, args.quick)
+    print(f"# profile_capture: config={args.config} clusters={len(specs)} "
+          f"ticks={n_ticks} backend={jax.default_backend()}", file=sys.stderr)
+
+    # ---- per-phase cost table (phase-prefix ablation on the real tick) --
+    rows, full = phase_table(cfg, specs, arrivals, n_ticks,
+                             repeats=args.repeats)
+    if not rows or not np.isfinite(full) or full <= 0:
+        print("profile_capture: per-phase table empty or degenerate",
+              file=sys.stderr)
+        return 1
+    width = max(len(r["phase"]) for r in rows)
+    print(f"{'phase':{width}s}  ms/tick   cum      frac")
+    for r in rows:
+        print(f"{r['phase']:{width}s}  {r['ms_per_tick']:7.4f}  "
+              f"{r['cum_ms_per_tick']:7.4f}  {r['fraction']:6.1%}")
+
+    # ---- profiler trace around one full-tick run ------------------------
+    artifacts = []
+    if not args.no_trace:
+        eng = Engine(cfg)
+        state0 = init_state(cfg, specs)
+        ta = pack_arrivals_by_tick(arrivals, n_ticks, cfg.tick_ms)
+        fn = jax.jit(eng.run, static_argnums=(2,))
+        jax.block_until_ready(fn(state0, ta, n_ticks))  # compile OUTSIDE
+        prof.start_trace(out_dir)
+        try:
+            with prof.annotate_dispatch("profile_capture", ticks=n_ticks):
+                out = fn(state0, ta, n_ticks)
+                np.asarray(out.t)
+        finally:
+            prof.stop_trace()
+        artifacts = prof.trace_artifacts(out_dir)
+        if not artifacts:
+            print("profile_capture: trace session produced no artifact",
+                  file=sys.stderr)
+            return 1
+        print(f"# trace: {len(artifacts)} file(s) under {out_dir}",
+              file=sys.stderr)
+
+    table_path = os.path.join(out_dir, f"phase_table_{args.config}.json")
+    with open(table_path, "w") as f:
+        json.dump({"config": args.config, "clusters": len(specs),
+                   "ticks": n_ticks, "backend": jax.default_backend(),
+                   "quick": args.quick, "full_ms_per_tick": round(full, 4),
+                   "phases": rows, "trace_artifacts": artifacts}, f, indent=2)
+    print(f"# table: {table_path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,181 @@
+"""obs-tap pass: the device metrics plane may only READ simulation state.
+
+The observability contract (obs/device.py, ARCHITECTURE.md
+§observability): a metric tap is a pure function from (buffer, cursor,
+state) to (buffer, cursor) — it reads ``SimState`` leaves and writes ONLY
+its own accumulators. One ``state.replace(...)`` inside a tap silently
+turns telemetry into simulation input, breaking the bit-invisibility gate
+every driver relies on (obs-on == obs-off final state) in a way only the
+full parity matrix would catch — so the discipline is machine-checked at
+the AST, like the rest of the rule families.
+
+**Tap scope** is any function in ``obs/`` that (a) is named ``tap_*`` or
+``reduce_*``, or (b) takes a parameter named ``state`` or annotated
+``SimState`` — the documented convention for device-side obs code
+(LINTING.md §9). Host-side harvest helpers take only the buffer and stay
+out of scope by construction. Inside a tap the pass flags:
+
+- **stores into sim state** — ``<state>.replace(...)`` calls and
+  ``<state>...at[...].set/.add/...`` index-update chains whose root is the
+  state parameter (the buffer's own ``.at`` updates are the legal idiom
+  and keep a different root);
+- **host coercions in jit scope** — ``np.asarray``/``np.array``,
+  ``jax.device_get``, ``.block_until_ready``, ``.item()``, and
+  ``float()/int()`` over the traced state/buffer params: taps run inside
+  the tick scan, where a host coercion is a tracer error at best and a
+  per-tick sync at worst (harvest-time coercion belongs in the host-side
+  helpers, which take no ``state``).
+
+Standalone-file targets engage this family when the file looks like a tap
+module (``module_is_tap``), the single-file convention gate the other
+scoped families use.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+RULE = "obs-tap"
+
+_COERCE_NP = ("asarray", "array")
+_COERCE_BUILTINS = ("float", "int", "bool")
+
+
+def module_is_tap(mod: Module) -> bool:
+    """Single-file convention gate: engage for files that carry tap code
+    (the MetricsBuffer type or tap_* functions)."""
+    return "MetricsBuffer" in mod.source or "def tap_" in mod.source
+
+
+def _root_name(node) -> str:
+    """The leftmost Name of an attribute/subscript chain
+    (``state.l0.count`` -> ``state``; ``mbuf.ring.at[i]`` -> ``mbuf``)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _np_aliases(mod: Module) -> set[str]:
+    heads = {"numpy"}
+    for alias, full in mod.module_aliases.items():
+        if full == "numpy":
+            heads.add(alias)
+    return heads
+
+
+def _jax_aliases(mod: Module) -> set[str]:
+    heads = {"jax"}
+    for alias, full in mod.module_aliases.items():
+        if full == "jax":
+            heads.add(alias)
+    return heads
+
+
+def _state_params(fn) -> set[str]:
+    """Parameter names that carry simulation state: named ``state`` or
+    annotated SimState."""
+    out = set()
+    for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+        ann = ""
+        if a.annotation is not None:
+            ann = ast.unparse(a.annotation)
+        if a.arg == "state" or "SimState" in ann:
+            out.add(a.arg)
+    return out
+
+
+def _traced_params(fn) -> set[str]:
+    """Every data parameter a tap traces over (state + buffer + cursor):
+    host-coercing ANY of them inside the tap is a violation."""
+    names = set()
+    for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+        names.add(a.arg)
+    names.discard("self")
+    # static shape/config scalars are legal to branch on
+    return {n for n in names if n not in ("tick_ms", "ex", "n", "k")}
+
+
+def _tap_functions(mod: Module):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if (node.name.startswith(("tap_", "reduce_"))
+                or _state_params(node)):
+            yield node
+
+
+def check_module(mod: Module) -> list[Finding]:
+    out: list[Finding] = []
+    np_heads = _np_aliases(mod)
+    jax_heads = _jax_aliases(mod)
+    seen: set[int] = set()
+    for fn in _tap_functions(mod):
+        states = _state_params(fn)
+        traced = _traced_params(fn)
+        for node in ast.walk(fn):
+            if id(node) in seen or not isinstance(node, ast.Call):
+                continue
+            seen.add(id(node))
+            f = node.func
+            # --- stores into sim state ---------------------------------
+            if isinstance(f, ast.Attribute) and f.attr == "replace" \
+                    and _root_name(f.value) in states:
+                out.append(Finding(
+                    mod.path, node.lineno, RULE,
+                    f"obs tap ({fn.name}) builds a modified SimState via "
+                    f"`{_root_name(f.value)}.replace(...)`: metric taps "
+                    "may only READ state leaves — telemetry must stay "
+                    "bitwise invisible to replay (write the MetricsBuffer "
+                    "instead)"))
+                continue
+            if isinstance(f, ast.Attribute) and f.attr in (
+                    "set", "add", "min", "max", "multiply", "divide"):
+                # X.at[i].set(v): walk to the chain root; a state-rooted
+                # index update is a store into sim state
+                base = f.value
+                if isinstance(base, ast.Subscript):
+                    inner = base.value
+                    if isinstance(inner, ast.Attribute) \
+                            and inner.attr == "at" \
+                            and _root_name(inner.value) in states:
+                        out.append(Finding(
+                            mod.path, node.lineno, RULE,
+                            f"obs tap ({fn.name}) index-updates a SimState "
+                            "leaf (`.at[...]."
+                            f"{f.attr}`): metric taps may only READ state "
+                            "— accumulate into the MetricsBuffer"))
+                        continue
+            # --- host coercions in jit scope ---------------------------
+            d_parts = []
+            g = f
+            while isinstance(g, ast.Attribute):
+                d_parts.append(g.attr)
+                g = g.value
+            head = g.id if isinstance(g, ast.Name) else ""
+            msg = None
+            if head in np_heads and d_parts and d_parts[0] in _COERCE_NP:
+                msg = (f"np.{d_parts[0]}() inside obs tap scope "
+                       f"({fn.name}): taps run inside the tick scan — "
+                       "host coercion belongs in the harvest helpers")
+            elif head in jax_heads and d_parts \
+                    and d_parts[0] == "device_get":
+                msg = (f"jax.device_get inside obs tap scope ({fn.name}): "
+                       "taps never touch the host")
+            elif isinstance(f, ast.Attribute) and f.attr in (
+                    "block_until_ready", "item") \
+                    and _root_name(f.value) in traced:
+                msg = (f".{f.attr}() on a traced value inside obs tap "
+                       f"scope ({fn.name}): taps never sync the device")
+            elif isinstance(f, ast.Name) and f.id in _COERCE_BUILTINS \
+                    and node.args \
+                    and _root_name(node.args[0]) in traced:
+                msg = (f"{f.id}() over a traced parameter inside obs tap "
+                       f"scope ({fn.name}): a Python coercion of traced "
+                       "data host-syncs (or fails to trace) inside jit")
+            if msg is not None:
+                out.append(Finding(mod.path, node.lineno, RULE, msg))
+    out.sort(key=lambda x: (x.line, x.message))
+    return out

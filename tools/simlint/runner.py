@@ -6,15 +6,16 @@ from __future__ import annotations
 from typing import Iterable, Optional
 
 from tools.simlint import (
-    compactstore, determinism, envrng, findings as F, lockset, policykernel,
-    purity, servesync, shardexchange,
+    compactstore, determinism, envrng, findings as F, lockset, obstap,
+    policykernel, purity, servesync, shardexchange,
 )
 from tools.simlint.callgraph import CallGraph
 from tools.simlint.project import Module, in_scope, load_target
 
 # package-relative scopes per family (ISSUE 2): the jitted tick path for
-# purity, the threaded hosts for locks, tick+market for determinism
-PURITY_DIRS = ("core", "ops", "parallel", "market", "envs")
+# purity, the threaded hosts for locks, tick+market for determinism.
+# obs/ joins the purity scope: its taps trace inside the tick scan.
+PURITY_DIRS = ("core", "ops", "parallel", "market", "envs", "obs")
 PURITY_EXTRA_FILES = ("services/host_ops.py",)
 LOCKSET_DIRS = ("services",)
 # workload/ builds the arrival streams the replay contract starts from —
@@ -44,8 +45,13 @@ ENV_RNG_RULES = ("env-rng",)
 # itself (exchange.py/multihost.py are the sanctioned modules, excluded
 # inside the pass)
 SHARD_EXCHANGE_DIRS = ("core", "ops", "market", "envs", "policies",
-                       "workload", "parallel")
+                       "workload", "parallel", "obs")
 SHARD_EXCHANGE_RULES = ("shard-exchange",)
+# the device metrics plane (ISSUE 12): taps in obs/ may only READ
+# SimState leaves (never store into sim state) and may not host-coerce
+# inside jit scope — the bit-invisibility contract, machine-checked
+OBS_TAP_DIRS = ("obs",)
+OBS_TAP_RULES = ("obs-tap",)
 # serving-tier handler discipline (ISSUE 11): no blocking device syncs in
 # HTTP/gRPC handler scope — handlers stage and read snapshots only; the
 # per-request reference hosts are sanctioned inside the pass (they ARE the
@@ -55,7 +61,7 @@ SERVE_SYNC_RULES = ("serve-sync",)
 PRAGMA_RULES = ("pragma-no-reason", "pragma-stale")
 ALL_RULES = (PURITY_RULES + LOCKSET_RULES + DET_RULES + COMPACT_RULES
              + POLICY_KERNEL_RULES + ENV_RNG_RULES + SHARD_EXCHANGE_RULES
-             + SERVE_SYNC_RULES + PRAGMA_RULES)
+             + SERVE_SYNC_RULES + OBS_TAP_RULES + PRAGMA_RULES)
 
 
 def run(target: str, rules: Optional[Iterable[str]] = None,
@@ -101,6 +107,10 @@ def run(target: str, rules: Optional[Iterable[str]] = None,
                 mod.relpath != "" or servesync.module_is_service(mod)):
             raw += servesync.check_module(mod)
             checked.update(SERVE_SYNC_RULES)
+        if in_scope(mod, OBS_TAP_DIRS) and (
+                mod.relpath != "" or obstap.module_is_tap(mod)):
+            raw += obstap.check_module(mod)
+            checked.update(OBS_TAP_RULES)
 
     if selected is not None:
         raw = [f for f in raw if f.rule in selected]

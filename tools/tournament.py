@@ -256,9 +256,12 @@ def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
             stacked)
 
     variant_params = [pset.params_for(cfg, name) for name in policies]
+    from multi_cluster_simulator_tpu.obs.profile import annotate_dispatch
     t0 = time.time()
-    grid = [jax.block_until_ready(fn(state0, stacked, p))
-            for p in variant_params]
+    with annotate_dispatch("tournament", variants=len(variant_params),
+                           seeds=n_seeds):
+        grid = [jax.block_until_ready(fn(state0, stacked, p))
+                for p in variant_params]
     tournament_wall = time.time() - t0
     cache_size = getattr(fn, "_cache_size", lambda: None)()
     if cache_size is None:
