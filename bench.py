@@ -153,6 +153,38 @@ def _peak_hbm_bytes():
     return int(peak) if peak is not None else None
 
 
+# CPU-child re-exec machinery, shared by the live/serving/faults configs:
+# each re-runs bench.py in a subprocess pinned to the host-CPU backend
+# (the engine-colocated-with-its-host deployment shape; the tunnel-attached
+# TPU pays ~0.5 s per dispatch). One marker list + one env builder so a
+# new child-mode config inherits the whole discipline — the axon
+# sitecustomize guard in _setup_jax included — instead of re-copying it.
+_CHILD_MARKERS = ("MCS_LIVE_CHILD", "MCS_SERVING_CHILD", "MCS_FAULTS_CHILD")
+
+
+def _is_bench_child() -> bool:
+    return any(os.environ.get(m) == "1" for m in _CHILD_MARKERS)
+
+
+def _cpu_child_env(marker: str, n_devices=None) -> dict:
+    """Environment for a re-exec'd CPU-pinned bench child: the child-mode
+    marker set, every TPU binding scrubbed, and (optionally) a virtual
+    CPU device count pinned before jax initializes."""
+    env = dict(os.environ)
+    env[marker] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    for k in list(env):
+        if k.startswith(("TPU_", "LIBTPU")) or k == "PJRT_DEVICE":
+            env.pop(k)
+    if n_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
 def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
                 repeats=3, warmups=0, tick_indexed=False, mesh_devices=None):
     """Advance n_ticks in jitted chunks (one device call per chunk — a single
@@ -1217,13 +1249,7 @@ def bench_live(quick=False):
     import time as _time
 
     if os.environ.get("MCS_LIVE_CHILD") != "1":
-        env = dict(os.environ)
-        env["MCS_LIVE_CHILD"] = "1"
-        env["JAX_PLATFORMS"] = "cpu"
-        env["JAX_PLATFORM_NAME"] = "cpu"
-        for k in list(env):
-            if k.startswith(("TPU_", "LIBTPU")) or k == "PJRT_DEVICE":
-                env.pop(k)
+        env = _cpu_child_env("MCS_LIVE_CHILD")
         args = [sys.executable, os.path.abspath(__file__), "--config", "live"]
         if quick:
             args.append("--quick")
@@ -1349,6 +1375,11 @@ def bench_live(quick=False):
         "vs_baseline": round(rate / (1_000_000 / 60.0), 3),
         "detail": {"jobs_placed": placed, "jobs_sent": total,
                    "wall_s": round(wall, 3),
+                   "client_retries_503": sum(c.retries_503 for c in clients),
+                   "client_conn_retries": sum(c.conn_retries
+                                              for c in clients),
+                   "client_retries_exhausted": sum(c.retries_exhausted
+                                                   for c in clients),
                    "schedulers": 2, "traders": 2, "clients": 2,
                    "requested_speed": speed,
                    "achieved_speed_per_scheduler": achieved_speed,
@@ -1400,13 +1431,7 @@ def bench_serving(quick=False):
     import time as _time
 
     if os.environ.get("MCS_SERVING_CHILD") != "1":
-        env = dict(os.environ)
-        env["MCS_SERVING_CHILD"] = "1"
-        env["JAX_PLATFORMS"] = "cpu"
-        env["JAX_PLATFORM_NAME"] = "cpu"
-        for k in list(env):
-            if k.startswith(("TPU_", "LIBTPU")) or k == "PJRT_DEVICE":
-                env.pop(k)
+        env = _cpu_child_env("MCS_SERVING_CHILD")
         args = [sys.executable, os.path.abspath(__file__),
                 "--config", "serving"]
         if quick:
@@ -1549,11 +1574,33 @@ def bench_serving(quick=False):
     # ---------------- shared wall-clock client machinery ----------------
     def run_clients(s, n_jobs, n_clients, batch, offered_rate=None,
                     sample=None):
+        from multi_cluster_simulator_tpu.services.backoff import (
+            jittered_backoff_ms,
+        )
+
         per = n_jobs // n_clients
+        # client-side backoff discipline: RetryAfterMs is the BASE of a
+        # jittered exponential (never a fixed sleep — synchronized clients
+        # re-collide on the same refill edge), and the attempt budget is
+        # bounded per batch — exhaustion FAILS the run (re-raised on the
+        # main thread below) instead of spinning forever
+        RETRY_BUDGET = 256
         counters = {"retries": 0, "rejected": 0}
         lock = threading.Lock()
+        # a worker thread's exception would otherwise vanish into
+        # threading.excepthook and the drain loop below would wait out its
+        # full deadline for jobs that can never arrive — capture and
+        # re-raise on the main thread after the join
+        errors: list[BaseException] = []
 
         def client(ci):
+            try:
+                _client_body(ci)
+            except BaseException as e:
+                with lock:
+                    errors.append(e)
+
+        def _client_body(ci):
             crng = np.random.default_rng(1000 + ci)
             gap = (batch / (offered_rate / n_clients)
                    if offered_rate else None)
@@ -1578,18 +1625,25 @@ def bench_serving(quick=False):
                     delay = nxt - _time.time()
                     if delay > 0:
                         _time.sleep(delay)
-                while True:
+                for attempt in range(RETRY_BUDGET + 1):
                     code, body = httpd.post_json(s.url + "/submitBatch",
                                                  batch_rows)
                     if code == 200:
                         break
                     assert code == 503, f"submit -> {code}"
+                    if attempt >= RETRY_BUDGET:
+                        raise AssertionError(
+                            f"client {ci}: retry budget ({RETRY_BUDGET}) "
+                            f"exhausted with {len(batch_rows)} jobs still "
+                            "back-pressured")
                     e = json.loads(body)
                     with lock:
                         counters["retries"] += 1
                         counters["rejected"] += len(e["RejectedIdx"])
                     batch_rows = [batch_rows[k] for k in e["RejectedIdx"]]
-                    _time.sleep(e["RetryAfterMs"] / 1000.0)
+                    _time.sleep(jittered_backoff_ms(
+                        attempt, max(float(e["RetryAfterMs"]), 1.0),
+                        2_000.0, crng) / 1000.0)
                 batch_rows = []
 
         ths = [threading.Thread(target=client, args=(i,))
@@ -1606,6 +1660,8 @@ def bench_serving(quick=False):
             _time.sleep(0.05)
         for th in ths:
             th.join()
+        if errors:
+            raise errors[0]
         submit_wall = _time.time() - t0
         total = per * n_clients
         deadline = _time.time() + (120 if quick else 600)
@@ -1692,6 +1748,9 @@ def bench_serving(quick=False):
             "clients": 4, "client_batch": 128,
             "retries_503": ctr_t["retries"],
             "rejected_jobs_quoted": ctr_t["rejected"],
+            "retry_discipline": "jittered-exp on RetryAfterMs, "
+                                "budget 256/batch (exhaustion fails the "
+                                "run)",
             "drops": drops_t,
         },
         "latency": lat_detail,
@@ -2055,6 +2114,190 @@ def bench_env(quick=False):
     }
 
 
+_FAULTS = {"mode": "off"}  # --faults {off,on,ab}
+
+
+def bench_faults(quick=False):
+    """The fault plane, gated on the artifact itself (``--faults``,
+    ARCHITECTURE.md §fault plane). A churn config — generative exponential
+    MTTF/MTTR failures over a FIFO-parity constellation — run through:
+
+    - **faults-off == baseline**: the fault phase is statically skipped
+      when disabled, and an ENABLED plane with an empty schedule leaves
+      every shared state leaf bitwise identical to the disabled run (the
+      phase is provably a no-op without events);
+    - **the plane engages**: nonzero kills AND requeues on the churn run
+      (a config whose faults never fire proves nothing);
+    - **mode ``ab``, the full parity matrix**: the faults-on final state
+      must be bit-identical across compact × time-compression × ragged
+      chunks × the 8-device mesh (and their composition) — churn is data
+      riding the state, invisible to every execution strategy.
+
+    Runs in a child pinned to CPU with 8 virtual devices (the
+    weak-scaling re-exec pattern: device count is fixed at backend
+    init)."""
+    import subprocess
+
+    mode = _FAULTS["mode"]
+    if os.environ.get("MCS_FAULTS_CHILD") != "1":
+        env = _cpu_child_env("MCS_FAULTS_CHILD", n_devices=8)
+        args = [sys.executable, os.path.abspath(__file__),
+                "--faults", mode if mode != "off" else "ab"]
+        if quick:
+            args.append("--quick")
+        proc = subprocess.run(args, env=env, capture_output=True, text=True,
+                              cwd=os.path.dirname(os.path.abspath(__file__)),
+                              timeout=3600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"faults child failed rc={proc.returncode}:\n"
+                f"{proc.stderr[-4000:]}")
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        for line in proc.stderr.splitlines():
+            if line.startswith("# detail: "):
+                result["detail"] = json.loads(line[len("# detail: "):])
+        return result
+
+    import jax
+    import jax.numpy as jnp
+
+    from multi_cluster_simulator_tpu.config import (
+        FaultConfig, PolicyKind, SimConfig,
+    )
+    from multi_cluster_simulator_tpu.core.compact import derive_plan, to_wide
+    from multi_cluster_simulator_tpu.core.engine import (
+        Engine, pack_arrivals_by_tick, pack_arrivals_chunks,
+    )
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.utils.trace import (
+        check_conservation, total_drops,
+    )
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+    C = 8 if quick else 32
+    jobs_per = 40 if quick else 200
+    horizon_ms = 120_000 if quick else 400_000
+    base = SimConfig(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                     queue_capacity=128, max_running=128,
+                     max_arrivals=jobs_per, max_ingest_per_tick=16,
+                     max_nodes=5, max_virtual_nodes=0)
+    # churn shape: several outages per node over the horizon, repairs an
+    # order of magnitude faster, and a retry budget deep enough that no
+    # job exhausts it (drops.failed must stay zero so every drop counter
+    # gates) — the plane must ENGAGE (kills/requeues > 0), not decimate
+    churn = FaultConfig(enabled=True, mode="generative",
+                        mttf_ms=horizon_ms // 4, mttr_ms=horizon_ms // 40,
+                        seed=29, max_retries=16)
+    cfg_on = dataclasses.replace(base, faults=churn)
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    arrivals = uniform_stream(C, jobs_per, horizon_ms, max_cores=8,
+                              max_mem=6_000, max_dur_ms=30_000, seed=13)
+    T = horizon_ms // base.tick_ms + 90
+    ta = pack_arrivals_by_tick(arrivals, T, base.tick_ms)
+
+    def tree_equal(a, b):
+        return all(np.array_equal(np.asarray(x), np.asarray(y))
+                   for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+    # ---- gate 1: faults-off bitwise == the baseline path ----
+    state_off = Engine(base).run_jit()(init_state(base, specs), ta, T)
+    cfg_empty = dataclasses.replace(
+        base, faults=dataclasses.replace(churn, mode="trace"))
+    state_empty = Engine(cfg_empty).run_jit()(
+        init_state(cfg_empty, specs, fault_events=[]), ta, T)
+    shared = lambda s: s.replace(faults=None)  # noqa: E731
+    assert tree_equal(shared(state_off), shared(state_empty)), (
+        "--faults: an ENABLED plane with an empty schedule diverged from "
+        "the disabled run — the fault phase is not a no-op without events")
+
+    # ---- gate 2: the plane engages on the churn config ----
+    eng = Engine(cfg_on)
+    fn = eng.run_jit()
+    state0 = init_state(cfg_on, specs)
+    ref = fn(jax.tree.map(jnp.copy, state0), ta, T)
+    walls = []
+    for _ in range(2 if quick else 3):
+        t0 = time.time()
+        out = fn(jax.tree.map(jnp.copy, state0), ta, T)
+        np.asarray(out.t)
+        walls.append(time.time() - t0)
+    kills = int(np.asarray(ref.faults.kills).sum())
+    requeues = int(np.asarray(ref.faults.requeues).sum())
+    down_ms = int(np.asarray(ref.faults.down_ms).sum())
+    assert kills > 0 and requeues > 0, (
+        f"--faults: the churn config produced {kills} kills / {requeues} "
+        "requeues — the fault plane never engaged")
+    drops = total_drops(ref)
+    assert all(v == 0 for v in drops.values()), (
+        f"--faults: drops moved under churn ({drops}) — either the bounds "
+        "bind or a job exhausted the deep retry budget")
+    check_conservation(ref)
+    placed = int(np.asarray(ref.placed_total).sum())
+
+    # ---- gate 3 (ab): the full parity matrix under churn ----
+    cells = []
+    if mode == "ab":
+        plan = derive_plan(cfg_on, specs, arrivals)
+
+        def check(name, out, compact=False):
+            got = to_wide(out) if compact else out
+            ok = tree_equal(got, ref)
+            assert ok, (f"--faults ab: parity cell {name!r} diverged "
+                        "bitwise from the dense/wide/single-device "
+                        "reference under churn")
+            cells.append(name)
+
+        check("compact", fn(init_state(cfg_on, specs, plan=plan), ta, T),
+              compact=True)
+        out_c, _stats = eng.run_compressed_jit()(
+            init_state(cfg_on, specs), ta, T)
+        check("compressed", out_c)
+        sizes = [T // 2, T // 3, T - T // 2 - T // 3]
+        st_ = init_state(cfg_on, specs)
+        for ch, n in zip(pack_arrivals_chunks(arrivals, sizes,
+                                              cfg_on.tick_ms), sizes):
+            st_ = fn(st_, ch, n)
+        check("chunked-ragged", st_)
+        if len(jax.devices()) >= 8 and C % 8 == 0:
+            from multi_cluster_simulator_tpu.parallel import (
+                ShardedEngine, make_mesh,
+            )
+            sh = ShardedEngine(cfg_on, make_mesh(8))
+            out_m = sh.run_fn(T, tick_indexed=True)(
+                sh.shard_state(init_state(cfg_on, specs)),
+                sh.shard_arrivals(ta))
+            check("mesh-8dev", out_m)
+            out_x, _ = sh.run_fn(T, tick_indexed=True, time_compress=True)(
+                sh.shard_state(init_state(cfg_on, specs, plan=plan)),
+                sh.shard_arrivals(ta))
+            check("mesh+compact+compressed", out_x, compact=True)
+
+    rate = placed / max(min(walls), 1e-9)
+    return {
+        "metric": "fault_plane_churn_jobs_per_sec",
+        "value": round(rate, 1),
+        "unit": "jobs/s",
+        "vs_baseline": round(rate / (1_000_000 / 60.0), 3),
+        "detail": {
+            "mode": mode, "clusters": C, "jobs": placed,
+            "ticks": T, "wall_s": round(min(walls), 3),
+            "walls": [round(w, 3) for w in walls],
+            "fault_kills": kills, "fault_requeues": requeues,
+            "fault_drops_failed": drops["failed"],
+            "node_down_ms": down_ms,
+            "churn": {"mttf_ms": churn.mttf_ms, "mttr_ms": churn.mttr_ms,
+                      "max_retries": churn.max_retries,
+                      "mode": churn.mode, "seed": churn.seed},
+            "off_equals_empty_schedule": True,
+            "parity_cells_bit_identical": cells,
+            "drops": drops,
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+        },
+    }
+
+
 def bench_multichip(quick=False):
     """Weak-scaling constellation record (tools/weak_scaling.py, ROADMAP
     item 3): per-device-count rows (1/2/4/8) of the headline FIFO-parity
@@ -2126,6 +2369,7 @@ CONFIGS = {
     "tournament": bench_tournament,
     "env": bench_env,
     "multichip": bench_multichip,
+    "faults": bench_faults,
 }
 
 
@@ -2147,11 +2391,10 @@ def _setup_jax(cache_dir=None, cache_enabled=True):
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    if (os.environ.get("MCS_LIVE_CHILD") == "1"
-            or os.environ.get("MCS_SERVING_CHILD") == "1"):
+    if _is_bench_child():
         # the axon sitecustomize re-pins the TPU platform at interpreter
-        # startup regardless of env; force the live/serving child onto
-        # host CPU
+        # startup regardless of env; force every re-exec'd CPU child
+        # (live/serving/faults) onto the host backend
         jax.config.update("jax_platforms", "cpu")
 
 
@@ -2213,6 +2456,14 @@ def main():
                          "leap driver per chunk only when the bucketed "
                          "counts show a quiescent gap; ab runs compressed "
                          "then dense and records both walls in the detail")
+    ap.add_argument("--faults", choices=("off", "on", "ab"), default="off",
+                    help="the fault plane gate (config `faults`): run the "
+                         "generative-churn config and assert the plane "
+                         "engages (nonzero kills/requeues), faults-off "
+                         "stays bitwise the baseline path, and — with ab "
+                         "— every faults-on parity cell (compact x "
+                         "time-compression x ragged chunks x 8-device "
+                         "mesh) is bit-identical")
     ap.add_argument("--obs", choices=("off", "on", "ab"), default="off",
                     help="device metrics plane (obs/): thread a "
                          "MetricsBuffer through the scan carry, harvested "
@@ -2237,6 +2488,9 @@ def main():
         args.config = "env"
     if args.multichip:
         args.config = "multichip"
+    if args.faults != "off":
+        args.config = "faults"
+        _FAULTS["mode"] = args.faults
     _setup_jax(args.compile_cache_dir, not args.no_compile_cache)
     _CKPT["path"] = args.checkpoint
     _CKPT["resume"] = args.resume
@@ -2294,14 +2548,14 @@ def main():
 
         _PIPELINE["mode"] = "on" if args.pipeline == "ab" else args.pipeline
         res = call()
-        if args.pipeline == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip"):
+        if args.pipeline == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip", "faults"):
             ab_compare(res, _PIPELINE, "on", "pipeline_ab",
                        "pipelined", "unpipelined")
-        if args.time_compress == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip"):
+        if args.time_compress == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip", "faults"):
             ab_compare(res, _TIME_COMPRESS, "auto", "time_compress_ab",
                        "compressed", "dense",
                        extra=("ticks_executed", "ticks_simulated"))
-        if args.compact == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip"):
+        if args.compact == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip", "faults"):
 
             def compact_gates(d, doff, ab):
                 # correctness gate, not just walls: the wide re-run must
@@ -2365,8 +2619,7 @@ def main():
         # re-enters main() in a subprocess: its partial single-config view
         # would transiently clobber the record the parent is about to merge
         # into (ADVICE r5)
-        if (os.environ.get("MCS_LIVE_CHILD") != "1"
-                and os.environ.get("MCS_SERVING_CHILD") != "1"):
+        if not _is_bench_child():
             try:
                 with open(results_path) as f:
                     results = json.load(f)

@@ -90,6 +90,43 @@ class TraderConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """The fault plane (faults/): deterministic node churn as DATA.
+
+    The reference simulates a fantasy datacenter — nodes never fail and a
+    job, once placed, always completes. Real schedulers are shaped by churn
+    (Gavel's rounds exist because placements must survive preemption,
+    arxiv 2008.09213; Blox treats failure handling as a first-class
+    toolkit axis, arxiv 2312.12621). With ``enabled`` the engine runs a
+    fault phase at tick entry (core/engine.py): nodes fail on a per-node
+    schedule, jobs running on a failed node are killed and requeued with
+    their ``retries`` row field bumped (past ``max_retries`` they count
+    into ``drops.failed``), the node's capacity is masked out while down,
+    and repair restores an empty node. Failure schedules are either
+
+    - ``mode="generative"`` — on-device inverse-CDF exponential sampling
+      of per-node time-to-failure (``mttf_ms``) and time-to-repair
+      (``mttr_ms``) from counter-based per-cluster PRNG streams (seeded by
+      ``seed`` + global cluster index, so results are bit-identical under
+      any sharding/chunking/compression of the run); or
+    - ``mode="trace"`` — an explicit event list packed host-side into
+      per-node interval tables (faults/schedule.pack_fault_trace), the
+      ``pack_arrivals_by_tick`` move applied to failures.
+
+    Sub-tick event times round up to the next tick boundary exactly like
+    arrivals; within a tick, failures apply before repairs (a same-tick
+    fail+repair is a zero-length outage that still kills)."""
+
+    enabled: bool = False
+    mode: str = "generative"  # "generative" | "trace"
+    mttf_ms: int = 600_000  # mean time to failure per node (generative)
+    mttr_ms: int = 60_000  # mean time to repair (generative)
+    seed: int = 77
+    max_retries: int = 3  # kills a job survives before drops.failed
+    max_events: int = 8  # trace-mode fail/repair interval slots per node
+
+
+@dataclasses.dataclass(frozen=True)
 class WorkloadConfig:
     """Workload generator. Reference: pkg/client/client.go:85-147."""
 
@@ -161,6 +198,7 @@ class SimConfig:
 
     trader: TraderConfig = dataclasses.field(default_factory=TraderConfig)
     workload: WorkloadConfig = dataclasses.field(default_factory=WorkloadConfig)
+    faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
 
     @property
     def total_nodes(self) -> int:

@@ -155,6 +155,10 @@ def derive_plan(cfg: SimConfig, specs: Sequence[ClusterSpec],
         # schema-bounded, not stream-bounded: job_class maps any demand
         # into [0, N_JOB_CLASSES) by construction (ops/fields.py)
         "jclass": (0, F.N_JOB_CLASSES - 1),
+        # config-bounded: the fault phase only requeues while
+        # retries < max_retries, so a stored value never exceeds the
+        # budget (a kill at the budget drops into drops.failed instead)
+        "retries": (0, max(int(cfg.faults.max_retries), 1)),
     }
 
     def row_plan(names):

@@ -12,6 +12,9 @@ ops (which become XLA collectives when the cluster axis is sharded).
 Tick phase order (the documented determinization of the reference's
 concurrent goroutines — see PARITY.md):
 
+  0. fault phase (faults/ — no reference analogue: the Go system never
+     fails a node): due node failures kill + requeue the jobs running on
+     them, mask the node's capacity out, and due repairs restore it
   1. completions with ``end_t <= t`` release resources (RunJob wakeups);
      finished foreign jobs are returned to their borrower (JobFinished ->
      ReturnToBorrower -> /lent, scheduler.go:158-191, server.go:260-290)
@@ -52,6 +55,7 @@ from flax import struct
 from multi_cluster_simulator_tpu.config import SimConfig
 from multi_cluster_simulator_tpu.core import state as st
 from multi_cluster_simulator_tpu.core.state import Arrivals, SimState
+from multi_cluster_simulator_tpu.faults import apply as faults_apply
 from multi_cluster_simulator_tpu.obs import device as obs_device
 from multi_cluster_simulator_tpu.obs.profile import phase_scope
 from multi_cluster_simulator_tpu.ops import fields as F
@@ -128,7 +132,12 @@ def _quiescence_sig(state: SimState) -> jax.Array:
         jnp.sum(state.lent.count), jnp.sum(state.borrowed.count),
         jnp.sum(state.node_active.astype(jnp.int32)),
         jnp.sum(d.queue) + jnp.sum(d.msgs) + jnp.sum(d.run_full)
-        + jnp.sum(d.vslot) + jnp.sum(d.carve) + jnp.sum(d.ingest) + ovf,
+        + jnp.sum(d.vslot) + jnp.sum(d.carve) + jnp.sum(d.ingest)
+        + jnp.sum(d.failed) + ovf,
+        # fault plane: health membership, completed outages, kill/requeue
+        # counters — a tick that only fails or repairs an (empty) node
+        # must never be judged quiescent (faults/apply.py sig_parts)
+        *faults_apply.sig_parts(state),
     ]
     return jnp.stack([p.astype(jnp.int32) for p in parts])
 
@@ -167,6 +176,10 @@ def _next_event_t(state: SimState, t, cfg: SimConfig, pset: PolicySet,
         if cfg.trader.expire_virtual_nodes:
             ev = jnp.minimum(ev, jnp.min(jnp.where(
                 state.node_active, state.node_expire, R.NEVER)))
+    if cfg.faults.enabled:
+        # a leap can never jump over a failure or a repair: the next fault
+        # event joins the bound exactly like completions and promotions
+        ev = jnp.minimum(ev, faults_apply.next_fault_event_t(state.faults))
     return ev
 
 
@@ -306,7 +319,7 @@ def pack_arrivals(arr: Arrivals) -> tuple[jax.Array, jax.Array]:
     zero = jnp.zeros(arr.t.shape, jnp.int32)
     vals = {"id": arr.id, "cores": arr.cores, "mem": arr.mem, "gpu": arr.gpu,
             "dur": arr.dur, "enq_t": arr.t, "owner": own, "rec_wait": zero,
-            "jclass": F.job_class(arr.cores, arr.gpu)}
+            "jclass": F.job_class(arr.cores, arr.gpu), "retries": zero}
     rows = jnp.stack([vals[n] for n in F.QUEUE_FIELDS],
                      axis=-1).astype(jnp.int32)
     return rows, arr.n
@@ -350,7 +363,8 @@ def _bucket_arrivals_host(arr: Arrivals, n_ticks: int, tick_ms: int):
             "owner": np.full_like(t, int(Q.OWN)),
             "rec_wait": np.zeros_like(t),
             "jclass": F.job_class(np.asarray(arr.cores),
-                                  np.asarray(arr.gpu)).astype(np.int32)}
+                                  np.asarray(arr.gpu)).astype(np.int32),
+            "retries": np.zeros_like(t)}
     fields = np.stack([vals[n] for n in F.QUEUE_FIELDS], axis=-1)  # [C, A, NF]
     return fields, dest, ok, rank, counts2d.T[:n_ticks].copy()
 
@@ -676,7 +690,8 @@ class Engine:
         config-derived defaults, baked as constants). ``phase_limit``:
         static int truncating the body after the first N phases
         (obs.profile.TICK_PHASES order) — the profile plane's ablation
-        hook (``run_prefix``/tools/profile_capture.py); None runs all 7.
+        hook (``run_prefix``/tools/profile_capture.py); None runs all
+        phases (obs.profile.TICK_PHASES has the authoritative count).
         Every phase is wrapped in a ``jax.named_scope`` so profiler
         captures attribute device time per phase (trace-time metadata
         only — bitwise invisible to the compiled program's results)."""
@@ -700,9 +715,32 @@ class Engine:
             state = state.replace(node_free=F.widen(state.node_free),
                                   node_cap=F.widen(state.node_cap))
 
-        # 1. completions (+ returns of finished foreign jobs)
+        # 1. fault phase (faults/apply.py): node failures kill + requeue
+        # the jobs running on them BEFORE completions fire (a job ending
+        # on the tick its node dies is killed, not completed), capacity
+        # masks out, repairs restore. The requeue target is the policy's
+        # ingest queue — same static/traced dispatch as the arrival phase.
+        if cfg.faults.enabled and phase_on(1):
+            with phase_scope("faults"):
+                def run_faults(s_, to_delay):
+                    return jax.vmap(
+                        functools.partial(faults_apply.fault_phase_local,
+                                          cfg=cfg, to_delay=to_delay),
+                        in_axes=(_STATE_AXES, None),
+                        out_axes=_STATE_AXES)(s_, t)
+
+                fdelay = self.pset.ingest_to_delay()
+                if fdelay is not None:
+                    state = run_faults(state, fdelay)
+                else:
+                    flag = self.pset.to_delay_table()[params.idx]
+                    state = jax.lax.cond(
+                        flag, lambda s_: run_faults(s_, True),
+                        lambda s_: run_faults(s_, False), state)
+
+        # 2. completions (+ returns of finished foreign jobs)
         with phase_scope("release"):
-            if phase_on(1):
+            if phase_on(2):
                 run_before = state.run
                 st2, done = jax.vmap(_release_local,
                                      in_axes=(_STATE_AXES, None),
@@ -710,7 +748,7 @@ class Engine:
                 state = st2
             else:
                 done = jnp.zeros(state.run.active.shape, bool)
-            if phase_on(1) and (cfg.borrowing or emit_io):
+            if phase_on(2) and (cfg.borrowing or emit_io):
                 ret_rows, ret_valid, ret_dropped = _pack_returns(
                     run_before, done, cfg.max_msgs)
                 state = state.replace(drops=state.drops.replace(
@@ -719,18 +757,18 @@ class Engine:
                 C = done.shape[0]
                 ret_rows = jnp.zeros((C, cfg.max_msgs, R.RF), jnp.int32)
                 ret_valid = jnp.zeros((C, cfg.max_msgs), bool)
-            if phase_on(1) and cfg.borrowing:
+            if phase_on(2) and cfg.borrowing:
                 state = _deliver_returns(state, ret_rows, ret_valid, self.ex)
 
-        # 2. virtual-node expiry (off in parity mode — reference keeps them)
+        # 3. virtual-node expiry (off in parity mode — reference keeps them)
         if cfg.trader.enabled and cfg.trader.expire_virtual_nodes \
-                and phase_on(2):
+                and phase_on(3):
             with phase_scope("expire"):
                 state = jax.vmap(_expire_vnodes_local,
                                  in_axes=(_STATE_AXES, None),
                                  out_axes=_STATE_AXES)(state, t)
 
-        # 3. arrivals — the ingest target is the active policy's (Level0
+        # 4. arrivals — the ingest target is the active policy's (Level0
         # for the queue-sweep families, ReadyQueue for FIFO). Static when
         # every compiled set member agrees (the singleton/classic case —
         # identical to the old cfg.policy branch); a mixed set switches on
@@ -744,7 +782,7 @@ class Engine:
                 in_axes=(_STATE_AXES, 0, 0, None),
                 out_axes=_STATE_AXES)(s_, arr_rows, arr_n, t)
 
-        if phase_on(3):
+        if phase_on(4):
             with phase_scope("ingest"):
                 to_delay = self.pset.ingest_to_delay()
                 if to_delay is not None:
@@ -756,10 +794,10 @@ class Engine:
                                          lambda s_: run_ingest(s_, False),
                                          state)
 
-        # 4. scheduling pass: the policy zoo's dispatch (policies/base.py) —
+        # 5. scheduling pass: the policy zoo's dispatch (policies/base.py) —
         # the member params.idx selects runs its batched kernel; non-FIFO
         # members emit an all-False borrow_want
-        if phase_on(4):
+        if phase_on(5):
             with phase_scope("schedule"):
                 state, want, bjob_vec = self.pset.dispatch(state, t, params,
                                                            cfg)
@@ -767,21 +805,21 @@ class Engine:
             C = state.arr_ptr.shape[0]
             want = jnp.zeros((C,), bool)
             bjob_vec = jnp.zeros((C, Q.NF), jnp.int32)
-        # 5. borrow matching (FIFO-family cells only: want is identically
+        # 6. borrow matching (FIFO-family cells only: want is identically
         # False elsewhere, making the match a bitwise no-op for those cells)
-        if cfg.borrowing and self.pset.has_fifo and phase_on(5):
+        if cfg.borrowing and self.pset.has_fifo and phase_on(6):
             with phase_scope("borrow"):
                 state = _borrow_match(state, want, Q.JobRec(vec=bjob_vec),
                                       cfg, self.ex)
 
-        # 6. trader state snapshot (before any trade in the same tick — the
+        # 7. trader state snapshot (before any trade in the same tick — the
         # stream lands just ahead of the monitor wakeup, MARKET.md §clock)
-        if cfg.trader.enabled and phase_on(6):
+        if cfg.trader.enabled and phase_on(7):
             with phase_scope("snapshot"):
                 state = _snapshot(state, t, cfg)
 
-        # 7. trader market round
-        if self._trade_round is not None and phase_on(7):
+        # 8. trader market round
+        if self._trade_round is not None and phase_on(8):
             with phase_scope("trade"):
                 state = self._trade_round(state, t)
 
@@ -956,7 +994,7 @@ class Engine:
     def run_compressed(self, state: SimState, arrivals: st.TickArrivals,
                        n_ticks: int, params=None, mbuf=None):
         """``run`` with event-compressed virtual time: a ``while_loop`` that
-        executes a real 7-phase tick only when something can happen, and
+        executes a real full-phase tick only when something can happen, and
         otherwise leaps the clock to the next event in one step — the
         classic fixed-increment -> next-event DES speedup, bit-identical to
         the dense scan (ARCHITECTURE.md §time compression).

@@ -22,6 +22,9 @@ from multi_cluster_simulator_tpu.config import SimConfig
 from multi_cluster_simulator_tpu.core.spec import (
     CORES, MEM, RES, ClusterSpec, capacities_array, node_types_array,
 )
+from multi_cluster_simulator_tpu.faults.schedule import (
+    FaultState, init_fault_state,
+)
 from multi_cluster_simulator_tpu.ops import queues as Q
 from multi_cluster_simulator_tpu.ops import runset as R
 
@@ -120,6 +123,10 @@ class Drops:
     #                      it is deferral-ticks, not jobs. (Go ingests all
     #                      due arrivals at once; a binding window skews
     #                      timing.)
+    failed: jax.Array  # [C] i32 — jobs killed by node failures past their
+    #                      retry budget (faults/apply.py): deliberately lost
+    #                      work, not a sizing bug — zero whenever the fault
+    #                      plane is off or max_retries covers the churn
 
 
 @struct.dataclass
@@ -162,6 +169,8 @@ class SimState:
     drops: Drops
     trader: TraderState
     trace: Trace
+    faults: FaultState  # node churn (faults/) — inert all-healthy leaves
+    #                     when cfg.faults.enabled is False
 
 
 # vmap prefix for the per-cluster tick phases: map every per-cluster field
@@ -171,7 +180,7 @@ STATE_AXES = SimState(
     t=None, node_cap=0, node_free=0, node_active=0, node_expire=0,
     node_type=0, l0=0, l1=0, ready=0, wait=0, lent=0, borrowed=0, run=0,
     arr_ptr=0, wait_total=0, wait_jobs=0, jobs_in_queue=0, placed_total=0,
-    drops=0, trader=0, trace=0,
+    drops=0, trader=0, trace=0, faults=0,
 )
 
 
@@ -245,14 +254,19 @@ def snapshot_utilization(s: SimState) -> tuple[jax.Array, jax.Array]:
 
 
 def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec],
-               plan=None) -> SimState:
+               plan=None, fault_events=None) -> SimState:
     """Build the initial batched state from cluster specs.
 
     ``plan`` is an optional ``core.compact.CompactPlan``: when given, the
     six job queues and the running set are built in the compact SoA layout
     with the plan's range-audited storage dtypes (bit-identical results;
     ARCHITECTURE.md §state layout). ``None`` keeps the wide int32 AoS
-    layout."""
+    layout.
+
+    ``fault_events`` is the trace-mode fault schedule — a list of
+    ``(cluster, node, fail_t_ms, repair_t_ms)`` tuples packed into the
+    per-node interval tables (faults/schedule.py); required iff
+    ``cfg.faults`` enables trace mode, ignored otherwise."""
     C = len(specs)
     N = cfg.total_nodes
     cap_phys = capacities_array(specs, cfg.max_nodes)  # [C, max_nodes, RES]
@@ -309,7 +323,7 @@ def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec],
         jobs_in_queue=zi,
         placed_total=zi,
         drops=Drops(queue=zi, msgs=zi, run_full=zi, vslot=zi, carve=zi,
-                    ingest=zi),
+                    ingest=zi, failed=zi),
         trader=TraderState(
             snap_core_util=zf,
             snap_mem_util=zf,
@@ -328,4 +342,9 @@ def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec],
             src=jnp.full((C, E), -1, jnp.int32),
             n=zi,
         ),
+        # generative churn is scoped to the machines that exist: the
+        # initially-active slots (phantom padding and vacant virtual
+        # slots cannot fail — trace schedules may still name any slot)
+        faults=init_fault_state(cfg.faults, C, N, events=fault_events,
+                                eligible=active),
     )

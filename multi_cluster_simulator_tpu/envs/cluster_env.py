@@ -106,7 +106,8 @@ def _drop_sum(s: SimState) -> jax.Array:
     utils/trace.total_drops, for the drop-penalty reward."""
     d = s.drops
     total = (jnp.sum(d.queue) + jnp.sum(d.msgs) + jnp.sum(d.run_full)
-             + jnp.sum(d.vslot) + jnp.sum(d.carve) + jnp.sum(d.ingest))
+             + jnp.sum(d.vslot) + jnp.sum(d.carve) + jnp.sum(d.ingest)
+             + jnp.sum(d.failed))
     for part in (s.l0, s.l1, s.ready, s.wait, s.lent, s.borrowed, s.run):
         if hasattr(part, "ovf"):
             total = total + jnp.sum(part.ovf)
@@ -164,6 +165,17 @@ class ClusterEnv:
             raise ValueError("reward weights must be 3 floats "
                              "(wait, throughput, drop)")
         self._sim0 = init_state(cfg, specs, plan=plan)
+        # generative churn trains under failure (ROADMAP "as many
+        # scenarios as you can imagine"): each env folds its OWN reset key
+        # into the per-cluster fault streams, so the batch sees
+        # independent failure patterns (trace-mode tables replay
+        # identically in every env, like replay arrivals)
+        self._fault_gen = (cfg.faults.enabled
+                           and cfg.faults.mode == "generative")
+        # churn eligibility: the reset constellation's real machines
+        # (faults/schedule.initial_next_fail — padding/vacant slots never
+        # fail generatively)
+        self._fault_eligible = self._sim0.node_active
 
     # -- geometry ----------------------------------------------------------
 
@@ -194,8 +206,18 @@ class ClusterEnv:
 
     def reset(self, key):
         """One env instance: (obs, EnvState) from a per-env key. Batched
-        form: ``reset_batch`` (vmap over split keys)."""
-        es = EnvState(sim=self._sim0, key=key, t_ep=jnp.int32(0),
+        form: ``reset_batch`` (vmap over split keys). With generative
+        faults the env's churn streams derive from a branch of the reset
+        key (``faults.reseed``) — never the base config seed shared across
+        the batch (the env-rng discipline)."""
+        sim = self._sim0
+        if self._fault_gen:
+            from multi_cluster_simulator_tpu.faults import schedule as fsch
+            key, kf = jax.random.split(key)
+            sim = sim.replace(faults=fsch.reseed(
+                sim.faults, kf, self.cfg.faults,
+                eligible=self._fault_eligible))
+        es = EnvState(sim=sim, key=key, t_ep=jnp.int32(0),
                       episodes=jnp.int32(0), reward_w=self._reward_w)
         return observe(es.sim, self.cfg), es
 
@@ -242,6 +264,20 @@ class ClusterEnv:
         # back to the cached reset constellation — no host round-trip, ever
         sim3 = jax.tree.map(lambda fresh, cur: jnp.where(done, fresh, cur),
                             sim0, sim2)
+        if self._fault_gen:
+            # the broadcast reset state carries the BASE fault streams;
+            # this env's churn must survive auto-reset, so keep the env's
+            # per-cluster keys and re-derive the episode-0 failure clocks
+            # from them (the same draw reseed() makes at reset time)
+            from multi_cluster_simulator_tpu.faults import schedule as fsch
+            fkeys = sim2.faults.key  # constant within an episode
+            C, N = sim2.faults.health.shape
+            nf0 = jax.vmap(lambda k, e: fsch.initial_next_fail(
+                k, N, self.cfg.faults, e))(fkeys, self._fault_eligible)
+            f3 = sim3.faults.replace(
+                key=fkeys,
+                next_fail=jnp.where(done, nf0, sim2.faults.next_fail))
+            sim3 = sim3.replace(faults=f3)
         es2 = EnvState(
             sim=sim3, key=key,
             t_ep=jnp.where(done, jnp.int32(0), es.t_ep + 1),

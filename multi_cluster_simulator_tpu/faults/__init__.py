@@ -1,0 +1,27 @@
+"""The fault plane: deterministic node churn + job preemption/retry as data.
+
+Two modules, no engine imports (core/state.py embeds ``FaultState`` in the
+``SimState`` pytree, so this package must sit below core in the import
+graph):
+
+- ``faults.schedule`` — the ``FaultState`` pytree (per-cluster leaves, so
+  it shards over the mesh with the rest of the state and needs zero new
+  collectives), host-side schedule packing (``pack_fault_trace`` — the
+  ``pack_arrivals_by_tick`` move applied to failures), the counter-based
+  on-device exponential samplers for generative MTTF/MTTR churn, and the
+  builders/reseeders the drivers call.
+- ``faults.apply`` — the per-cluster fault phase the engine runs at tick
+  entry (kill + requeue + capacity masking + repair), the next-event probe
+  the time-compression leap bound folds in (a leap can never jump over a
+  failure or a repair), and the quiescence-signature parts.
+
+See ARCHITECTURE.md §fault plane.
+"""
+
+from multi_cluster_simulator_tpu.faults.apply import (  # noqa: F401
+    fault_phase_local, next_fault_event_t, sig_parts,
+)
+from multi_cluster_simulator_tpu.faults.schedule import (  # noqa: F401
+    FaultState, init_fault_state, initial_next_fail, pack_fault_trace,
+    reseed,
+)

@@ -1,0 +1,178 @@
+"""The fault phase: kill, requeue, mask, repair — one cluster per call.
+
+Runs at tick entry (core/engine.py ``_tick`` phase 1, before completions),
+vmapped over the cluster axis like every per-cluster phase. Semantics,
+each documented in PARITY.md §fault schedules:
+
+- **Failures before completions.** A job whose ``end_t`` falls on the same
+  tick its node fails is killed, not completed — the failure took the node
+  before the tick's release phase observed it.
+- **Failures before repairs.** Within one tick, every due failure applies,
+  then every due repair — so a same-tick fail+repair (a zero-length trace
+  interval, or a malformed repair<=fail pair) is a zero-length outage that
+  still kills and still counts one ``n_fails``.
+- **Kill = requeue with a bumped retry budget.** Killed rows whose
+  ``retries < max_retries`` re-enter a queue with ``enq_t = t`` (the wait
+  clock restarts; the reference's WaitTime is per-enqueue),
+  ``rec_wait = 0``, ``retries + 1``, and owner preserved. OWN jobs go to
+  the policy's ingest queue (Level0 for the queue-sweep families,
+  ReadyQueue for FIFO — the same target dispatch as the arrival phase);
+  jobs a peer lent me (owner >= 0) go back to the LENT queue — where
+  foreign jobs live in the reference — so a killed foreign job is
+  re-placed best-effort and, when it finally completes, returns to its
+  borrower like any lent job (never via the wait queue, where a second
+  borrow would overwrite its ownership). Rows at the budget count into
+  ``drops.failed`` instead. Trader carve placeholders (owner == FOREIGN
+  == -2) are not jobs: they die with the node and are not requeued — the
+  carved capacity returns to the seller at repair while the buyer keeps
+  its virtual node (the reference never reconciles a broken contract
+  either).
+- **Capacity masks out, repair restores an empty node.** A failed node's
+  ``node_free`` zeroes and ``node_active`` drops (every feasibility,
+  lend, carve, and utilization path is already active-gated, so the whole
+  policy zoo is failure-aware with no kernel change); ``was_active``
+  remembers the pre-fail activation so repair restores a vacant virtual
+  slot as vacant and an occupied node as ``free = cap`` (all its jobs
+  were killed at fail time). Virtual-node ATTACH additionally skips
+  unhealthy slots (market/trader.py buyer_apply, services/host_ops.py) —
+  a down slot must not be reclaimed by a new contract mid-outage.
+
+All arithmetic is int32 on widened loads (the engine widens compact node
+storage before this phase); requeued rows go through the checked
+``Q.push_many`` stores, so the compact layouts stay bit-identical to wide
+(tests/test_faults.py pins the full parity matrix).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.faults import schedule as fsched
+from multi_cluster_simulator_tpu.faults.schedule import NEVER, FaultState
+from multi_cluster_simulator_tpu.ops import fields as F
+from multi_cluster_simulator_tpu.ops import queues as Q
+from multi_cluster_simulator_tpu.ops import runset as R
+
+FOREIGN = jnp.int32(-2)  # market/trader.py's carve-placeholder owner
+
+
+def next_fault_event_t(fs: FaultState) -> jnp.ndarray:
+    """Earliest future fault event across the (local) constellation: an up
+    node's next failure or a down node's repair. Folded into the
+    time-compression leap bound (core/engine.py ``_next_event_t``) — a
+    leap can never jump over a failure or a repair."""
+    return jnp.min(jnp.where(fs.health, fs.next_fail, fs.down_until))
+
+
+def sig_parts(state) -> list:
+    """Fault-plane terms of the quiescence fingerprint
+    (core/engine.py ``_quiescence_sig``): health membership, completed
+    outages, and the kill/requeue counters — so a tick that only fails or
+    repairs an (empty) node can never be judged quiescent, and the
+    closed-form leap accrual stays exact between fault events."""
+    fs = state.faults
+    return [jnp.sum(fs.health.astype(jnp.int32)), jnp.sum(fs.n_fails),
+            jnp.sum(fs.kills) + jnp.sum(fs.requeues)]
+
+
+def fault_phase_local(s, t, cfg: SimConfig, to_delay: bool):
+    """One cluster's fault phase (vmapped by the engine). ``t`` is this
+    tick's clock; ``to_delay`` the policy's ingest target (static per
+    compiled branch, exactly like the arrival phase)."""
+    fc = cfg.faults
+    fs = s.faults
+    N = fs.health.shape[0]
+    t = jnp.asarray(t, jnp.int32)
+    trace_mode = fc.mode == "trace"
+
+    # ---- failures due this tick ----
+    fail_now = jnp.logical_and(fs.health, fs.next_fail <= t)  # [N]
+    run = s.run
+    # which running slots sit on a newly-failed node (one-hot contraction,
+    # not a gather — the phase is vmapped over thousands of clusters)
+    node_hot = (run.node[:, None]
+                == jnp.arange(N, dtype=jnp.int32)[None, :])  # [S, N]
+    on_failed = jnp.einsum("sn,n->s", node_hot.astype(jnp.int32),
+                           fail_now.astype(jnp.int32)) > 0
+    killed = jnp.logical_and(run.active, on_failed)  # [S]
+    is_job = jnp.logical_and(killed, run.owner != FOREIGN)
+    retryable = jnp.logical_and(is_job, run.retries < jnp.int32(fc.max_retries))
+    exhausted = jnp.sum(jnp.logical_and(
+        is_job, run.retries >= jnp.int32(fc.max_retries))).astype(jnp.int32)
+    # foreign jobs I host (owner >= 0, the FIFO borrowing path) requeue
+    # into the LENT queue; my own jobs into the policy's ingest target
+    to_lent = jnp.logical_and(retryable, run.owner >= 0)
+    to_ingest = jnp.logical_and(retryable, run.owner < 0)
+    n_req = jnp.sum(retryable).astype(jnp.int32)
+    n_ing = jnp.sum(to_ingest).astype(jnp.int32)
+
+    # requeued rows in the queue schema: identity + demand from the run
+    # row, the wait clock restarted at t, the retry budget bumped
+    zeros = jnp.zeros_like(run.id)
+    vals = {"id": run.id, "cores": run.cores, "mem": run.mem,
+            "gpu": run.gpu, "dur": run.dur, "enq_t": jnp.full_like(run.id, t),
+            "owner": run.owner, "rec_wait": zeros,
+            "jclass": F.job_class(run.cores, run.gpu),
+            "retries": run.retries + 1}
+    rows = jnp.stack([vals[n] for n in F.QUEUE_FIELDS],
+                     axis=-1).astype(jnp.int32)  # [S, NF]
+    batch = Q.JobQueue(data=rows, count=n_req)
+
+    run = R.kill(run, killed)
+    tgt = s.l0 if to_delay else s.ready
+    dropped = Q.push_many_dropped(tgt, to_ingest)
+    tgt = Q.push_many(tgt, batch, to_ingest)
+    ldropped = Q.push_many_dropped(s.lent, to_lent)
+    lent = Q.push_many(s.lent, batch, to_lent)
+    s = s.replace(
+        run=run, lent=lent,
+        drops=s.drops.replace(queue=s.drops.queue + dropped + ldropped,
+                              failed=s.drops.failed + exhausted))
+    if to_delay:
+        # mirror the arrival phase's DELAY-side accounting: a requeue is a
+        # re-arrival for the WaitTime stats (server.go:75-76 analogue)
+        s = s.replace(l0=tgt, wait_jobs=s.wait_jobs + n_ing,
+                      jobs_in_queue=s.jobs_in_queue + n_ing)
+    else:
+        s = s.replace(ready=tgt)
+
+    # node bookkeeping: capacity out, activation parked, outage opened
+    free = jnp.where(fail_now[:, None], 0, s.node_free)
+    was_active = jnp.where(fail_now, s.node_active, fs.was_active)
+    active = jnp.logical_and(s.node_active, jnp.logical_not(fail_now))
+    if trace_mode:
+        du_new = fsched.gather_event(fs.repair_t, fs.n_fails)
+    else:
+        du_new = t + fsched._exp_draws(fs.key, fs.n_fails, 1, fc.mttr_ms)
+    down_until = jnp.where(fail_now, du_new, fs.down_until)
+    next_fail = jnp.where(fail_now, NEVER, fs.next_fail)
+    down_since = jnp.where(fail_now, t, fs.down_since)
+    health = jnp.logical_and(fs.health, jnp.logical_not(fail_now))
+    kills = fs.kills + jnp.sum(is_job).astype(jnp.int32)
+    requeues = fs.requeues + n_req
+
+    # ---- repairs due this tick (after failures: a same-tick pair is a
+    # zero-length outage that still kills) ----
+    rep_now = jnp.logical_and(jnp.logical_not(health), down_until <= t)
+    active = jnp.where(rep_now, was_active, active)
+    # the node comes back EMPTY (everything on it was killed at fail
+    # time), so restored free is simply the capacity
+    free = jnp.where(rep_now[:, None], s.node_cap, free)
+    down_ms = fs.down_ms + jnp.sum(
+        jnp.where(rep_now, t - down_since, 0)).astype(jnp.int32)
+    n_fails = fs.n_fails + rep_now.astype(jnp.int32)
+    if trace_mode:
+        nf_new = fsched.gather_event(fs.fail_t, n_fails)
+    else:
+        nf_new = t + fsched._exp_draws(fs.key, n_fails, 0, fc.mttf_ms)
+    next_fail = jnp.where(rep_now, nf_new, next_fail)
+    down_until = jnp.where(rep_now, NEVER, down_until)
+    health = jnp.logical_or(health, rep_now)
+
+    return s.replace(
+        node_free=free, node_active=active,
+        faults=fs.replace(health=health, was_active=was_active,
+                          next_fail=next_fail, down_until=down_until,
+                          down_since=down_since, n_fails=n_fails,
+                          kills=kills, requeues=requeues, down_ms=down_ms))

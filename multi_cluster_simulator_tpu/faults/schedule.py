@@ -1,0 +1,223 @@
+"""Fault schedules as data: the ``FaultState`` pytree + its builders.
+
+A failure schedule is a per-node alternating sequence of (fail, repair)
+times. Both sources reduce to the same two device columns the fault phase
+reads — ``next_fail`` (the clock of the next failure, NEVER when none is
+scheduled) and ``down_until`` (the repair clock while down) — so the apply
+core (faults/apply.py) is mode-blind; the mode only decides where the NEXT
+interval comes from when one completes:
+
+- **trace** — an explicit host-side event list packed once into per-node
+  sorted interval tables ``fail_t``/``repair_t`` ([C, N, E], NEVER-padded)
+  with the per-node cursor ``n_fails`` indexing them: the
+  ``pack_arrivals_by_tick`` pattern applied to failures. Replay order,
+  chunking, compression, and sharding are all invisible to it by
+  construction — the tables ride the state.
+- **generative** — on-device inverse-CDF exponential sampling
+  (``dt = ceil(-mean * log(U))``, no rejection loops — the PR-7 lesson:
+  ``jax.random``'s rejection-sampled distributions cost ~25x a whole tick
+  under vmap) from COUNTER-BASED streams: draw k for node n of cluster c
+  is ``fold_in(fold_in(fold_in(key_c, n), 2k + kind))``, a pure function
+  of (cluster key, node, failure ordinal), never of the tick index or the
+  driver — which is what makes generative churn bit-identical across
+  dense/compressed/chunked/sharded execution (tests/test_faults.py).
+
+Every leaf is per-cluster ([C, ...]), so the whole pytree shards over the
+mesh's cluster axis with the rest of ``SimState`` (parallel/sharded_engine
+``_state_specs``) and checkpoints with it (core/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from multi_cluster_simulator_tpu.config import FaultConfig
+
+NEVER = jnp.int32(2**31 - 1)
+# generative draws are clamped so ``t + dt`` stays far from int32 wrap even
+# at the log(1/U) tail (U >= 1e-7 -> dt <= ~16.2 * mean)
+_MAX_DT = jnp.int32(1 << 30)
+
+
+@struct.dataclass
+class FaultState:
+    """Per-cluster node-churn state. ``health`` is the mask placement sees
+    (a failed node also has ``node_active`` masked off and ``node_free``
+    zeroed, so every existing feasibility/lend/carve path is failure-aware
+    without a change); ``was_active`` remembers the pre-fail activation so
+    repair restores a vacant virtual slot as vacant. Counters (``kills``,
+    ``requeues``, ``down_ms``) are cumulative per cluster — the obs/ tap
+    differences them like ``placed_total``."""
+
+    health: jax.Array  # [C, N] bool — True = up
+    was_active: jax.Array  # [C, N] bool — node_active at fail time
+    next_fail: jax.Array  # [C, N] i32 — clock of the next failure (NEVER: none)
+    down_until: jax.Array  # [C, N] i32 — repair clock while down (NEVER: up)
+    down_since: jax.Array  # [C, N] i32 — fail clock of the current outage
+    n_fails: jax.Array  # [C, N] i32 — completed outages (cursor + PRNG counter)
+    kills: jax.Array  # [C] i32 — jobs killed by node failures
+    requeues: jax.Array  # [C] i32 — killed jobs requeued (retry granted)
+    down_ms: jax.Array  # [C] i32 — node downtime, closed at repair
+    # trace-mode interval tables (E=1 NEVER-filled placeholders otherwise)
+    fail_t: jax.Array  # [C, N, E] i32 — interval starts, NEVER-padded
+    repair_t: jax.Array  # [C, N, E] i32 — interval ends
+    key: jax.Array  # [C, 2] u32 — per-cluster generative stream root
+
+
+def _exp_draws(key: jax.Array, counters: jax.Array, kind: int,
+               mean_ms: int) -> jax.Array:
+    """[N] exponential durations (ms, >= 1) for every node's draw ordinal
+    ``counters`` — one inverse-CDF uniform per node, keys derived per
+    (node, ordinal, kind). ``kind`` 0 = time-to-failure, 1 = time-to-repair
+    (distinct substreams so the two sequences never collide)."""
+    n = counters.shape[0]
+
+    def draw(node, k):
+        kk = jax.random.fold_in(jax.random.fold_in(key, node), 2 * k + kind)
+        u = jax.random.uniform(kk, (), jnp.float32, 1e-7, 1.0)
+        return jnp.ceil(-jnp.float32(mean_ms) * jnp.log(u))
+
+    dt = jax.vmap(draw)(jnp.arange(n, dtype=jnp.int32), counters)
+    return jnp.clip(dt, 1.0, _MAX_DT.astype(jnp.float32)).astype(jnp.int32)
+
+
+def gather_event(table: jax.Array, cursor: jax.Array) -> jax.Array:
+    """[N] entry ``table[n, cursor[n]]`` with NEVER past the last interval
+    — the trace-mode next-interval lookup (single-cluster view)."""
+    E = table.shape[-1]
+    idx = jnp.clip(cursor, 0, E - 1)
+    got = jnp.take_along_axis(table, idx[:, None], axis=-1)[:, 0]
+    return jnp.where(cursor < E, got, NEVER)
+
+
+def initial_next_fail(key: jax.Array, n_nodes: int, fc: FaultConfig,
+                      eligible=None) -> jax.Array:
+    """[N] first-failure clocks for one cluster in generative mode (draw
+    ordinal 0, relative to t=0) — shared by ``init_fault_state``,
+    ``reseed``, and the env auto-reset (envs/cluster_env.py), so a reset
+    episode replays the exact schedule a fresh env with the same key
+    sees. ``eligible`` [N] masks churn to REAL machines: phantom padded
+    slots and vacant virtual slots get NEVER — generative churn models
+    physical hardware failing (a node that does not exist cannot fail,
+    and scheduling it anyway would both fabricate ``down_ms`` and force
+    the leap driver to execute no-op ticks); trace mode can still name
+    any slot explicitly."""
+    nf = _exp_draws(key, jnp.zeros((n_nodes,), jnp.int32), 0, fc.mttf_ms)
+    if eligible is None:
+        return nf
+    return jnp.where(jnp.asarray(eligible), nf, NEVER)
+
+
+def pack_fault_trace(events: Sequence[tuple], C: int, N: int,
+                     max_events: int) -> tuple[np.ndarray, np.ndarray]:
+    """Pack an explicit ``(cluster, node, fail_t_ms, repair_t_ms)`` event
+    list into the per-node sorted interval tables (host-side numpy, once
+    per run — the arrivals-bucketing move). Intervals sort by fail time;
+    adversarial orderings are allowed and well-defined (a repair at or
+    before its fail makes a zero-length outage that still kills —
+    PARITY.md §fault schedules). More than ``max_events`` intervals on one
+    node fail fast rather than silently truncate."""
+    fail = np.full((C, N, max_events), int(np.asarray(NEVER)), np.int32)
+    repair = np.full((C, N, max_events), int(np.asarray(NEVER)), np.int32)
+    per_node: dict[tuple, list] = {}
+    for c, n, ft, rt in events:
+        if not (0 <= c < C and 0 <= n < N):
+            raise ValueError(f"fault event ({c}, {n}) outside [{C}, {N})")
+        per_node.setdefault((int(c), int(n)), []).append((int(ft), int(rt)))
+    for (c, n), ivals in per_node.items():
+        if len(ivals) > max_events:
+            raise ValueError(
+                f"node ({c}, {n}) has {len(ivals)} fault intervals; "
+                f"faults.max_events={max_events} — raise the bound")
+        ivals.sort()
+        for i, (ft, rt) in enumerate(ivals):
+            fail[c, n, i] = ft
+            repair[c, n, i] = rt
+    return fail, repair
+
+
+def init_fault_state(fc: FaultConfig, C: int, N: int,
+                     events: Optional[Sequence[tuple]] = None,
+                     eligible=None) -> FaultState:
+    """The pristine all-healthy fault state for a fresh constellation.
+
+    ``events`` supplies the trace-mode schedule (required iff
+    ``fc.mode == "trace"`` and ``fc.enabled``); generative mode derives
+    per-cluster keys from ``fc.seed`` + the GLOBAL cluster index, so the
+    leaf carries each cluster's identity onto whatever shard it lands on,
+    and samples first-failure clocks only for ``eligible`` [C, N] slots
+    (``initial_next_fail`` — real machines, not padding/vacant virtual
+    slots). With ``fc.enabled`` False the phase is statically skipped by
+    the engine and these leaves are inert zeros-and-NEVERs."""
+    E = max(int(fc.max_events), 1)
+    never = np.full((C, N), int(np.asarray(NEVER)), np.int32)
+    zeros_cn = np.zeros((C, N), np.int32)
+    if fc.enabled and fc.mode == "trace":
+        if events is None:
+            raise ValueError("faults.mode='trace' needs an event list "
+                             "(init_state(..., fault_events=...))")
+        fail_t, repair_t = pack_fault_trace(events, C, N, E)
+        next_fail = fail_t[:, :, 0].copy()
+        keys = np.zeros((C, 2), np.uint32)
+    else:
+        fail_t = np.full((C, N, E), int(np.asarray(NEVER)), np.int32)
+        repair_t = fail_t.copy()
+        if fc.enabled:
+            # one vectorized derivation for the whole constellation (a
+            # per-cluster host loop would pay C tiny dispatches at init)
+            root = jax.random.PRNGKey(fc.seed)
+            keys = np.asarray(jax.vmap(
+                lambda c: jax.random.fold_in(root, c))(
+                    jnp.arange(C, dtype=jnp.int32)), np.uint32)
+            elig = (jnp.ones((C, N), bool) if eligible is None
+                    else jnp.asarray(eligible))
+            next_fail = np.asarray(jax.vmap(
+                lambda k, e: initial_next_fail(k, N, fc, e))(
+                    jnp.asarray(keys), elig))
+        else:
+            keys = np.zeros((C, 2), np.uint32)
+            next_fail = never.copy()
+    zc = jnp.zeros((C,), jnp.int32)
+    return FaultState(
+        health=jnp.ones((C, N), bool),
+        was_active=jnp.zeros((C, N), bool),
+        next_fail=jnp.asarray(next_fail),
+        down_until=jnp.asarray(never),
+        down_since=jnp.asarray(zeros_cn),
+        n_fails=jnp.asarray(zeros_cn),
+        kills=zc, requeues=zc, down_ms=zc,
+        fail_t=jnp.asarray(fail_t), repair_t=jnp.asarray(repair_t),
+        key=jnp.asarray(keys))
+
+
+def reseed(fs: FaultState, key: jax.Array, fc: FaultConfig,
+           eligible=None) -> FaultState:
+    """Re-derive a pristine generative fault state from a fresh root key —
+    the environment mode's per-env churn (envs/cluster_env.py reset):
+    every env folds its own reset key into the per-cluster streams, so a
+    batch of envs trains under INDEPENDENT failure patterns. ``eligible``
+    [C, N] masks churn to real machines (see ``initial_next_fail``).
+    Traced-safe (pure jnp/jax.random on the existing leaf shapes)."""
+    C, N = fs.health.shape
+    keys = jax.vmap(lambda c: jax.random.fold_in(key, c))(
+        jnp.arange(C, dtype=jnp.int32))
+    elig = (jnp.ones((C, N), bool) if eligible is None
+            else jnp.asarray(eligible))
+    next_fail = jax.vmap(lambda k, e: initial_next_fail(k, N, fc, e))(
+        keys, elig)
+    return fs.replace(
+        health=jnp.ones((C, N), bool),
+        was_active=jnp.zeros((C, N), bool),
+        next_fail=next_fail,
+        down_until=jnp.full((C, N), NEVER, jnp.int32),
+        down_since=jnp.zeros((C, N), jnp.int32),
+        n_fails=jnp.zeros((C, N), jnp.int32),
+        kills=jnp.zeros((C,), jnp.int32),
+        requeues=jnp.zeros((C,), jnp.int32),
+        down_ms=jnp.zeros((C,), jnp.int32),
+        key=keys)

@@ -347,10 +347,14 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
     wcon = con  # own contract per local buyer
     got_node = jnp.logical_and(buyer, has_winner[gidx])
 
-    def buyer_apply(cap, free_b, active, expire, ccon, got):
+    def buyer_apply(cap, free_b, active, expire, health, ccon, got):
         vstart = cfg.max_nodes
         is_v = jnp.arange(cap.shape[0]) >= vstart
-        slot_free = jnp.logical_and(is_v, jnp.logical_not(active))
+        # a DOWN slot (fault plane, faults/) is inactive but not vacant:
+        # its was_active/cap are parked for repair, so a new contract must
+        # not reclaim it mid-outage — attach only to healthy vacant slots
+        slot_free = jnp.logical_and(
+            is_v, jnp.logical_and(jnp.logical_not(active), health))
         slot = jnp.argmax(slot_free).astype(jnp.int32)
         ok = jnp.logical_and(got, jnp.any(slot_free))
         newcap = jnp.stack([ccon.cores, ccon.mem, ccon.gpu]).astype(jnp.int32)
@@ -363,7 +367,8 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
         return cap, free_b, active, expire, vmiss.astype(jnp.int32)
 
     cap, free, active, expire, vslot_miss = jax.vmap(buyer_apply)(
-        state.node_cap, free, state.node_active, state.node_expire, wcon, got_node)
+        state.node_cap, free, state.node_active, state.node_expire,
+        state.faults.health, wcon, got_node)
 
     # ---- cooldowns (the 4 min / 2 min sleeps, trader.go:296-302) ----
     cooldown = jnp.where(

@@ -10,7 +10,7 @@ invisibility to replay:
   rings and histogram buckets, harvested once per chunk at the existing
   chunk boundary (one transfer per chunk, never per tick);
 - **profile plane** (``obs/profile.py``): ``jax.profiler``-native phase
-  annotation — named scopes on the 7 tick phases and TraceAnnotations
+  annotation — named scopes on the tick phases and TraceAnnotations
   around every dispatch site — plus ``tools/profile_capture.py``;
 - **serving surface**: a Prometheus-text ``/metrics`` endpoint and
   ``/healthz`` on the service hosts (services/lifecycle.py,

@@ -83,6 +83,12 @@ class MetricsBuffer:
     ovf: jax.Array  # [C] i32 — narrow-store overflows surfaced
     depth_sum: jax.Array  # [C] i32 — Σ per-tick queue depth
     depth_max: jax.Array  # [C] i32
+    # fault plane (faults/): per-window deltas of the cumulative churn
+    # counters — zero whenever the plane is off
+    kills: jax.Array  # [C] i32 — jobs killed by node failures
+    requeues: jax.Array  # [C] i32 — killed jobs granted a retry
+    fail_drops: jax.Array  # [C] i32 — kills past the retry budget
+    node_down_ms: jax.Array  # [C] i32 — node downtime closed this window
     # shard-local partials (leading axis 1 = this shard)
     depth_hist: jax.Array  # [1, B] i32 — log2 depth histogram
     ring_placed: jax.Array  # [1, R] i32 — per-tick placed (local sum)
@@ -108,6 +114,10 @@ class TapCursor:
     lent: jax.Array  # [C] i32 (lent.count)
     wait: jax.Array  # [C] f32 (wait_total)
     ovf: jax.Array  # [C] i32 (narrow-store overflow total)
+    kills: jax.Array  # [C] i32 (faults.kills)
+    requeues: jax.Array  # [C] i32 (faults.requeues)
+    fail_drops: jax.Array  # [C] i32 (drops.failed)
+    down_ms: jax.Array  # [C] i32 (faults.down_ms)
 
 
 def queue_depth(state: SimState) -> jax.Array:
@@ -142,6 +152,7 @@ def metrics_init(state: SimState) -> MetricsBuffer:
         placed=zi, arrived=zi, borrows=zi,
         wait_accrued=jnp.zeros((C,), jnp.float32),
         ovf=zi, depth_sum=zi, depth_max=zi,
+        kills=zi, requeues=zi, fail_drops=zi, node_down_ms=zi,
         depth_hist=jnp.zeros((1, OBS_DEPTH_BUCKETS), jnp.int32),
         ring_placed=jnp.zeros((1, OBS_RING), jnp.int32),
         ring_depth=jnp.zeros((1, OBS_RING), jnp.int32),
@@ -157,7 +168,10 @@ def cursor_of(state: SimState) -> TapCursor:
     left behind."""
     return TapCursor(placed=state.placed_total, arrived=state.arr_ptr,
                      lent=state.lent.count, wait=state.wait_total,
-                     ovf=_ovf_total(state))
+                     ovf=_ovf_total(state),
+                     kills=state.faults.kills, requeues=state.faults.requeues,
+                     fail_drops=state.drops.failed,
+                     down_ms=state.faults.down_ms)
 
 
 def _depth_buckets(depth: jax.Array) -> jax.Array:
@@ -184,6 +198,10 @@ def tap_tick(mbuf: MetricsBuffer, cur: TapCursor, state: SimState,
         borrows=mbuf.borrows + lent_d,
         wait_accrued=mbuf.wait_accrued + (state.wait_total - cur.wait),
         ovf=mbuf.ovf + (ovf_now - cur.ovf),
+        kills=mbuf.kills + (state.faults.kills - cur.kills),
+        requeues=mbuf.requeues + (state.faults.requeues - cur.requeues),
+        fail_drops=mbuf.fail_drops + (state.drops.failed - cur.fail_drops),
+        node_down_ms=mbuf.node_down_ms + (state.faults.down_ms - cur.down_ms),
         depth_sum=mbuf.depth_sum + depth,
         depth_max=jnp.maximum(mbuf.depth_max, depth),
         depth_hist=mbuf.depth_hist.at[0, _depth_buckets(depth)].add(1),
@@ -195,7 +213,10 @@ def tap_tick(mbuf: MetricsBuffer, cur: TapCursor, state: SimState,
     )
     cur = TapCursor(placed=state.placed_total, arrived=state.arr_ptr,
                     lent=state.lent.count, wait=state.wait_total,
-                    ovf=ovf_now)
+                    ovf=ovf_now,
+                    kills=state.faults.kills, requeues=state.faults.requeues,
+                    fail_drops=state.drops.failed,
+                    down_ms=state.faults.down_ms)
     return mbuf, cur
 
 
@@ -208,9 +229,10 @@ def tap_leap(mbuf: MetricsBuffer, cur: TapCursor, state: SimState,
     landing tick, wait accrual applied); ``n_skip=0`` is the identity, so
     the compressed driver calls this unconditionally after the leap cond.
 
-    Per-tick deltas (placed/arrived/borrows/ovf) are zero at a fixed
-    point, so only the cursors that moved (the closed-form wait accrual)
-    advance; per-tick levels replicate: depth_sum += n_skip·depth, the
+    Per-tick deltas (placed/arrived/borrows/ovf and the fault counters —
+    the leap bound never jumps a fail/repair event, so the churn leaves
+    are constant across the gap) are zero at a fixed point, so only the
+    cursors that moved (the closed-form wait accrual) advance; per-tick levels replicate: depth_sum += n_skip·depth, the
     histogram bucket of the fixed depth gains n_skip, and each covered
     ring slot takes the LATEST skipped tick that maps to it (slot j keeps
     ordinal q = m + n_skip - ((m + n_skip - j) mod R), covered iff
@@ -280,6 +302,10 @@ def harvest(mbuf: MetricsBuffer) -> dict:
         "borrows": int(leaves["borrows"].sum()),
         "wait_accrued_ms": round(float(leaves["wait_accrued"].sum()), 3),
         "narrow_ovf": int(leaves["ovf"].sum()),
+        "fault_kills": int(leaves["kills"].sum()),
+        "fault_requeues": int(leaves["requeues"].sum()),
+        "fault_drops": int(leaves["fail_drops"].sum()),
+        "node_down_ms": int(leaves["node_down_ms"].sum()),
         "queue_depth_mean": round(depth_sum / max(ticks, 1), 3),
         "queue_depth_max": int(leaves["depth_max"].max(initial=0)),
         "depth_hist_log2": hist[:nz[-1] + 1].tolist() if len(nz) else [],

@@ -28,13 +28,14 @@ import jax
 # (core/engine.py module docstring; PARITY.md §phase order). Order matters:
 # phase k of the ablation driver (Engine.run_prefix) runs phases [1..k].
 TICK_PHASES = (
-    "release",   # 1. completions + finished-foreign returns
-    "expire",    # 2. virtual-node expiry (sane mode only)
-    "ingest",    # 3. arrivals -> Level0 / ReadyQueue
-    "schedule",  # 4. the policy zoo's scheduling pass
-    "borrow",    # 5. cross-cluster borrow matching
-    "snapshot",  # 6. trader state snapshot
-    "trade",     # 7. trader market round
+    "faults",    # 1. node failures kill/requeue, repairs restore (faults/)
+    "release",   # 2. completions + finished-foreign returns
+    "expire",    # 3. virtual-node expiry (sane mode only)
+    "ingest",    # 4. arrivals -> Level0 / ReadyQueue
+    "schedule",  # 5. the policy zoo's scheduling pass
+    "borrow",    # 6. cross-cluster borrow matching
+    "snapshot",  # 7. trader state snapshot
+    "trade",     # 8. trader market round
 )
 
 

@@ -35,11 +35,17 @@ import numpy as np
 # into a heterogeneity-aware policy's per-(class, device-type) throughput
 # matrix (policies/kernels.py gavel; Gavel, arxiv 2008.09213). It is derived
 # once at stream entry (``job_class``) and rides the row thereafter.
+# ``retries`` (the tenth field, the fault plane — faults/) counts how many
+# times a node failure has killed-and-requeued this job: arrival streams
+# enter at 0, the fault phase bumps it on every requeue, and a kill past
+# ``FaultConfig.max_retries`` drops the job into ``drops.failed`` instead
+# of requeueing (core/engine.py fault phase). It rides both row kinds so a
+# running job's budget survives placement.
 QUEUE_FIELDS = ("id", "cores", "mem", "gpu", "dur", "enq_t", "owner",
-                "rec_wait", "jclass")
+                "rec_wait", "jclass", "retries")
 QUEUE_INDEX = {name: i for i, name in enumerate(QUEUE_FIELDS)}
 # invalid-slot sentinel per field: id=-1, owner=OWN(-1), zeros elsewhere
-QUEUE_INVALID = (-1, 0, 0, 0, 0, 0, -1, 0, 0)
+QUEUE_INVALID = (-1, 0, 0, 0, 0, 0, -1, 0, 0, 0)
 
 # --------------------------------------------------------------------------
 # heterogeneity schema: job demand-shape classes x node device types
@@ -74,9 +80,9 @@ NEVER_I = 2**31 - 1  # end_t sentinel for "no completion scheduled"
 
 # (cores, mem, gpu) contiguous, ordered like spec.RES (release's slice)
 RUN_FIELDS = ("end_t", "node", "cores", "mem", "gpu", "id", "owner", "dur",
-              "enq_t")
+              "enq_t", "retries")
 RUN_INDEX = {name: i for i, name in enumerate(RUN_FIELDS)}
-RUN_INVALID = (NEVER_I, 0, 0, 0, 0, -1, -1, 0, 0)
+RUN_INVALID = (NEVER_I, 0, 0, 0, 0, -1, -1, 0, 0, 0)
 
 # Fields eligible for sub-int32 storage in the compact layouts. Everything
 # else stays int32 BY DESIGN, not by audit: timestamps, durations, and
@@ -88,7 +94,7 @@ RUN_INVALID = (NEVER_I, 0, 0, 0, 0, -1, -1, 0, 0)
 # keeps i32 otherwise, and the checked store counts any host-injected id
 # beyond the audited bound instead of wrapping).
 NARROWABLE = frozenset({"id", "cores", "mem", "gpu", "owner", "node",
-                        "jclass"})
+                        "jclass", "retries"})
 
 WIDE_DTYPE = np.dtype(np.int32)
 

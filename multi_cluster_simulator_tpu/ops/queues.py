@@ -47,10 +47,10 @@ OWN = jnp.int32(-1)  # owner value for "my own job" (Ownership == "")
 
 # packed row layout, derived from the canonical schema (ops/fields.py)
 NF = len(F.QUEUE_FIELDS)
-(FID, FCORES, FMEM, FGPU, FDUR, FENQ, FOWNER, FREC, FJCLASS) = (
+(FID, FCORES, FMEM, FGPU, FDUR, FENQ, FOWNER, FREC, FJCLASS, FRETRIES) = (
     F.QUEUE_INDEX[n]
     for n in ("id", "cores", "mem", "gpu", "dur", "enq_t", "owner",
-              "rec_wait", "jclass"))
+              "rec_wait", "jclass", "retries"))
 _FIDX = dict(F.QUEUE_INDEX)
 
 _INVALID_ROW = jnp.array(F.QUEUE_INVALID, jnp.int32)
@@ -100,16 +100,21 @@ class JobRec:
         return self.vec[..., FJCLASS]
 
     @property
+    def retries(self):
+        return self.vec[..., FRETRIES]
+
+    @property
     def res(self):
         """[..., RES] (cores, mem, gpu) — matches the node free/cap layout."""
         return self.vec[..., FCORES:FGPU + 1]
 
     @staticmethod
     def make(id=-1, cores=0, mem=0, gpu=0, dur=0, enq_t=0, owner=OWN,
-             rec_wait=0, jclass=None) -> "JobRec":
+             rec_wait=0, jclass=None, retries=0) -> "JobRec":
         if jclass is None:
             jclass = F.job_class(jnp.asarray(cores), jnp.asarray(gpu))
-        parts = [id, cores, mem, gpu, dur, enq_t, owner, rec_wait, jclass]
+        parts = [id, cores, mem, gpu, dur, enq_t, owner, rec_wait, jclass,
+                 retries]
         return JobRec(vec=jnp.stack([jnp.asarray(p, jnp.int32) for p in parts], axis=-1))
 
     @staticmethod
@@ -169,6 +174,10 @@ class JobQueue:
     def jclass(self):
         return self.data[..., FJCLASS]
 
+    @property
+    def retries(self):
+        return self.data[..., FRETRIES]
+
     def slot_valid(self) -> jax.Array:
         """[Q] bool — which slots hold live jobs."""
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.count
@@ -197,6 +206,7 @@ class SoAJobQueue:
     f_owner: jax.Array
     f_rec_wait: jax.Array
     f_jclass: jax.Array
+    f_retries: jax.Array
     count: jax.Array  # [] int32
     ovf: jax.Array  # [] int32 — checked-narrow overflow events
 
@@ -240,6 +250,10 @@ class SoAJobQueue:
     @property
     def jclass(self):
         return F.widen(self.f_jclass)
+
+    @property
+    def retries(self):
+        return F.widen(self.f_retries)
 
     def slot_valid(self) -> jax.Array:
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.count
@@ -301,12 +315,14 @@ def soa_to_wide(q: SoAJobQueue) -> JobQueue:
 
 
 def from_fields(id, cores, mem, gpu, dur, enq_t, owner, rec_wait, count,
-                jclass=None) -> JobQueue:
+                jclass=None, retries=None) -> JobQueue:
     """Build a wide queue from per-field [Q] arrays (one stack op)."""
     if jclass is None:
         jclass = F.job_class(jnp.asarray(cores), jnp.asarray(gpu))
+    if retries is None:
+        retries = jnp.zeros_like(jnp.asarray(id))
     data = jnp.stack([id, cores, mem, gpu, dur, enq_t, owner, rec_wait,
-                      jclass], axis=-1).astype(jnp.int32)
+                      jclass, retries], axis=-1).astype(jnp.int32)
     return JobQueue(data=data, count=jnp.asarray(count, jnp.int32))
 
 

@@ -32,7 +32,7 @@ NEVER = jnp.int32(F.NEVER_I)
 
 # packed row layout, derived from the canonical schema (ops/fields.py)
 RF = len(F.RUN_FIELDS)
-REND, RNODE, RCORES, RMEM, RGPU, RID, ROWNER, RDUR, RENQ = (
+REND, RNODE, RCORES, RMEM, RGPU, RID, ROWNER, RDUR, RENQ, RRETRIES = (
     F.RUN_INDEX[n] for n in F.RUN_FIELDS)
 
 _INVALID_ROW = jnp.array(F.RUN_INVALID, jnp.int32)
@@ -83,6 +83,10 @@ class RunningSet:
     def enq_t(self):
         return self.data[..., RENQ]
 
+    @property
+    def retries(self):
+        return self.data[..., RRETRIES]
+
 
 @struct.dataclass
 class SoARunningSet:
@@ -101,6 +105,7 @@ class SoARunningSet:
     f_owner: jax.Array
     f_dur: jax.Array
     f_enq_t: jax.Array
+    f_retries: jax.Array
     active: jax.Array  # [S] bool
     ovf: jax.Array  # [] int32
 
@@ -143,6 +148,10 @@ class SoARunningSet:
     @property
     def enq_t(self):
         return F.widen(self.f_enq_t)
+
+    @property
+    def retries(self):
+        return F.widen(self.f_retries)
 
 
 def _leaf(rs: SoARunningSet, name: str) -> jax.Array:
@@ -187,14 +196,15 @@ def gather_rows_along(rs, order: jax.Array) -> jax.Array:
     return jnp.take_along_axis(rs.data, order[..., None], axis=-2)
 
 
-def make_row(end_t, node, cores, mem, gpu, id, owner, dur, enq_t) -> jax.Array:
-    parts = [end_t, node, cores, mem, gpu, id, owner, dur, enq_t]
+def make_row(end_t, node, cores, mem, gpu, id, owner, dur, enq_t,
+             retries=0) -> jax.Array:
+    parts = [end_t, node, cores, mem, gpu, id, owner, dur, enq_t, retries]
     return jnp.stack([jnp.asarray(p, jnp.int32) for p in parts], axis=-1)
 
 
 def row_from_job(job: JobRec, node, t) -> jax.Array:
     return make_row(t + job.dur, node, job.cores, job.mem, job.gpu, job.id,
-                    job.owner, job.dur, job.enq_t)
+                    job.owner, job.dur, job.enq_t, job.retries)
 
 
 def insert_row(rs, hot: jax.Array, row: jax.Array):
@@ -294,6 +304,27 @@ def next_end_t(rs) -> jax.Array:
     next-event time (core/engine.py _next_event_t): no release can fire
     before the first tick whose clock reaches this value."""
     return jnp.min(jnp.where(rs.active, rs.end_t, NEVER))
+
+
+def kill(rs, dead: jax.Array):
+    """Clear the slots where ``dead`` [S] is set WITHOUT returning their
+    resources to the free tensor — the fault plane's removal half
+    (faults/apply.py): a killed job's node just lost its whole capacity to
+    the failure, so there is nothing to return; repair restores
+    ``free = cap`` on an empty node. Same slot-clearing discipline as
+    ``release``."""
+    dead = jnp.logical_and(rs.active, dead)
+    if isinstance(rs, SoARunningSet):
+        dead = F.pin(dead)
+        new = {("f_" + n): jnp.where(dead, _invalid(n, _leaf(rs, n).dtype),
+                                     _leaf(rs, n))
+               for n in F.RUN_FIELDS}
+        return rs.replace(active=jnp.logical_and(rs.active,
+                                                 jnp.logical_not(dead)),
+                          **new)
+    return RunningSet(
+        data=jnp.where(dead[:, None], _INVALID_ROW, rs.data),
+        active=jnp.logical_and(rs.active, jnp.logical_not(dead)))
 
 
 def release(rs, free: jax.Array, t: jax.Array):

@@ -43,14 +43,18 @@ from multi_cluster_simulator_tpu.parallel.exchange import MeshExchange
 
 def _state_specs(axis: str):
     """Pytree prefix: every per-cluster field sharded on its leading axis,
-    the scalar clock replicated."""
+    the scalar clock replicated. The fault plane's leaves (faults/) are
+    all per-cluster by construction — including the interval tables and
+    the per-cluster PRNG keys — so churn shards with the state and needs
+    zero new collectives."""
     shard, rep = P(axis), P()
     return SimState(
         t=rep, node_cap=shard, node_free=shard, node_active=shard,
         node_expire=shard, node_type=shard, l0=shard, l1=shard, ready=shard,
         wait=shard, lent=shard, borrowed=shard, run=shard, arr_ptr=shard,
         wait_total=shard, wait_jobs=shard, jobs_in_queue=shard,
-        placed_total=shard, drops=shard, trader=shard, trace=shard)
+        placed_total=shard, drops=shard, trader=shard, trace=shard,
+        faults=shard)
 
 
 def _arr_specs(axis: str):
@@ -70,6 +74,7 @@ def _metrics_specs(axis: str):
     return MetricsBuffer(
         ticks=rep, placed=shard, arrived=shard, borrows=shard,
         wait_accrued=shard, ovf=shard, depth_sum=shard, depth_max=shard,
+        kills=shard, requeues=shard, fail_drops=shard, node_down_ms=shard,
         depth_hist=P(axis, None), ring_placed=P(axis, None),
         ring_depth=P(axis, None), ring_t=rep, leap_hist=rep)
 
@@ -157,7 +162,8 @@ class ShardedEngine:
         from multi_cluster_simulator_tpu.obs.device import reduce_metrics
         ex = self.engine.ex
         _PER_CLUSTER = ("placed", "arrived", "borrows", "wait_accrued",
-                        "ovf", "depth_sum", "depth_max")
+                        "ovf", "depth_sum", "depth_max", "kills",
+                        "requeues", "fail_drops", "node_down_ms")
 
         def body(mb):
             mb = reduce_metrics(mb, ex)  # partials -> replicated allsums

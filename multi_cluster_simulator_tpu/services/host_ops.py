@@ -189,7 +189,11 @@ def add_virtual_node(state: SimState, cores, mem, dur_ms, vstart: int,
     cap0, free0 = state.node_cap[0], state.node_free[0]
     act0, exp0 = state.node_active[0], state.node_expire[0]
     is_v = jnp.arange(cap0.shape[0]) >= vstart
-    slot_free = jnp.logical_and(is_v, jnp.logical_not(act0))
+    # skip DOWN slots (fault plane): inactive-but-unhealthy means parked
+    # for repair, not vacant (market/trader.py buyer_apply, same rule)
+    slot_free = jnp.logical_and(
+        is_v, jnp.logical_and(jnp.logical_not(act0),
+                              state.faults.health[0]))
     slot = jnp.argmax(slot_free).astype(jnp.int32)
     ok = jnp.any(slot_free)
     newcap = jnp.stack([jnp.asarray(cores, jnp.int32),

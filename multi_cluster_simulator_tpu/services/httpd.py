@@ -165,10 +165,18 @@ def delete(url: str, data: bytes = b"", timeout: float = 5.0) -> tuple[int, byte
 
 
 def _do(req, timeout: float) -> tuple[int, bytes]:
+    import http.client
+
     try:
         with urllib.request.urlopen(req, timeout=timeout) as res:
             return res.status, res.read()
     except urllib.error.HTTPError as e:
         return e.code, e.read()
-    except (urllib.error.URLError, OSError, TimeoutError):
+    except (urllib.error.URLError, OSError, TimeoutError,
+            http.client.HTTPException):
+        # HTTPException covers a server dying MID-RESPONSE
+        # (IncompleteRead after the status line — a kill -9 between write
+        # and flush): same retryable transport failure as a refused
+        # connection, and the caller must not assume the request was or
+        # was not processed (tools/chaos.py leans on exactly that)
         return 0, b""

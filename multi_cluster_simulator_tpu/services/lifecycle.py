@@ -61,6 +61,16 @@ class Service:
         answering 200 off a wedged core."""
         return True, {}
 
+    def wedged(self) -> bool:
+        """True when a shutdown join timed out and a loop thread never
+        exited (the thread may still own shared state). ``shutdown``
+        checks this AFTER ``on_shutdown``: a wedged service keeps its
+        HTTP diagnostic surface alive — /healthz answering 503 with the
+        wedge named — instead of tearing the transport down and
+        returning as if shutdown succeeded; the process owner decides
+        what to kill."""
+        return bool(getattr(self, "_wedged", None))
+
     def _handle_healthz(self, body: bytes, headers: dict):
         import json
         ok, detail = self.health()
@@ -111,6 +121,17 @@ class Service:
         self.on_shutdown()
         if self.registry is not None:
             self.registry.shutdown()
+        if self.wedged():
+            # wedged-shutdown honesty: a loop thread blew its join timeout
+            # and may still own shared state. Leave the HTTP surface UP so
+            # /healthz reports the 503 wedge verdict (health() checks the
+            # flag unconditionally) — tearing the transport down here
+            # would be returning as if shutdown succeeded.
+            self.logger.error(
+                "%s at %s: shutdown wedged — keeping the diagnostic HTTP "
+                "surface alive (/healthz = 503)", self.service_name,
+                self.url)
+            return
         self.meter.stop_exporter()
         self.meter.export_otlp()  # final snapshot to the collector, if any
         self.tracer.shutdown()  # flush the last OTLP span batch

@@ -126,6 +126,11 @@ class SchedulerService(Service):
         self._owner_idx: dict[str, int] = {}
         self._stop = threading.Event()
         self._tick_thread: Optional[threading.Thread] = None
+        # wedged-shutdown honesty: the join timeout is an attribute so
+        # tests can shrink it; a blown timeout flips _wedged (and the
+        # /healthz verdict) instead of returning as if shutdown succeeded
+        self.stop_join_timeout_s = 10.0
+        self._wedged: Optional[str] = None
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix=f"{name}-io")
         self.ticks_run = 0
@@ -422,7 +427,17 @@ class SchedulerService(Service):
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1)
         if self._tick_thread is not None:
-            self._tick_thread.join(timeout=10)
+            self._tick_thread.join(timeout=self.stop_join_timeout_s)
+            if self._tick_thread.is_alive():
+                # the tick loop never exited — it may be mid-device-call
+                # and still owns the state lock's cadence; say so loudly
+                # and flip /healthz to 503 (lifecycle keeps the surface
+                # up for a wedged service) instead of a silent "stopped"
+                self._wedged = self._tick_thread.name
+                self.logger.error(
+                    "shutdown: %s did not exit within %.1fs — wedged; "
+                    "/healthz flipped to 503", self._wedged,
+                    self.stop_join_timeout_s)
         self._pool.shutdown(wait=False)
 
     def on_stopped(self) -> None:
@@ -687,6 +702,11 @@ class SchedulerService(Service):
         of slack covers a slow dispatch; the loop's own exception guard
         already keeps transient tick failures from killing it)."""
         checks = {}
+        if self._wedged:
+            # unconditional (survives _started flipping off): a wedged
+            # stop must read as unhealthy, never as a clean shutdown
+            checks["shutdown_wedged"] = False
+            checks["wedged_thread"] = self._wedged
         if self._started:
             checks["tick_thread_alive"] = (self._tick_thread is not None
                                            and self._tick_thread.is_alive())

@@ -50,6 +50,7 @@ of the same Job objects, one HTTP round trip for a client-side buffer.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Optional
@@ -80,7 +81,7 @@ def make_row(jid: int, cores: int, mem: int, gpu: int, dur_ms: int,
     builds, so staged buckets and stream buckets are interchangeable."""
     return (int(jid), int(cores), int(mem), int(gpu), int(dur_ms),
             int(enq_t), _OWNER, 0,
-            int(F.job_class(int(cores), int(gpu))))
+            int(F.job_class(int(cores), int(gpu))), 0)
 
 
 class Snapshot:
@@ -145,7 +146,28 @@ class ServingScheduler(Service):
                  max_staged: Optional[int] = None, pacer: bool = True,
                  snapshot_every: int = 1, track_latency: bool = False,
                  warm_k=(1,), obs: bool = True,
-                 snapshot_max_age_ms: Optional[float] = None, **kw):
+                 snapshot_max_age_ms: Optional[float] = None,
+                 wal_path: Optional[str] = None,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: int = 8, recover: bool = True,
+                 wal_rotate_bytes: int = 64 << 20, **kw):
+        """Crash recovery (services/wal.py, ARCHITECTURE.md §fault plane):
+        ``wal_path`` arms the staged-arrival write-ahead log — every
+        accepted submit is fsync'd to it BEFORE the 200-ack, so an acked
+        job survives kill -9; ``checkpoint_path`` adds periodic atomic
+        device-state checkpoints (core/checkpoint.py, every
+        ``checkpoint_every`` dispatches + one at clean shutdown/quiesce).
+        Checkpoints are also what BOUND the WAL (seek offsets + rotation
+        past ``wal_rotate_bytes`` anchor on the checkpoint watermark); a
+        WAL without checkpoints is full-history by design — recovery
+        replays it in its entirety — so arm both for a long-lived
+        service.
+        With ``recover`` (default) a restarting service restores the
+        checkpoint and replays the WAL suffix — acked-but-undispatched
+        jobs re-stage onto their original ticks, torn final records are
+        discarded + truncated, and replay is exactly-once against the
+        checkpoint's dispatch watermark. tools/chaos.py is the standing
+        proof harness."""
         super().__init__(name, registry_url=registry_url, speed=speed, **kw)
         self.specs = list(specs)
         self.cfg = cfg
@@ -237,11 +259,42 @@ class ServingScheduler(Service):
         self._stop = threading.Event()
         self._drive_thread: Optional[threading.Thread] = None
         self._pacer_thread: Optional[threading.Thread] = None
+        # wedged-shutdown honesty: join timeouts are attributes so tests
+        # can shrink them; a blown timeout flips _wedged (and /healthz)
+        # instead of returning as if shutdown succeeded
+        self.stop_join_timeout_s = 30.0
+        self.pacer_join_timeout_s = 10.0
+        self._wedged: Optional[str] = None  # thread name that never exited
+        # /admin/quiesce single-flight state: one maintenance thread ever
+        # owns the drain; late/retried requests attach to it
+        self._quiesce_start_lock = threading.Lock()  # guards: _quiesce_done, _quiesce_result
+        self._quiesce_done: Optional[threading.Event] = None
+        self._quiesce_result: dict = {}
+        # crash recovery (WAL + checkpoints — services/wal.py)
+        self.wal_path = wal_path
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = max(int(checkpoint_every), 1)
+        self.wal_rotate_bytes = int(wal_rotate_bytes)
+        self._wal = None
+        self._replaying = False  # replayed records must not re-append
+        self._parked_applied = 0  # parked rows pushed at dispatch edges
+        self.recovered_jobs = 0
+        self.wal_torn_tail = False
+        # per-staging-tick WAL byte offsets (first record of each tick) —
+        # what lets a checkpoint record a SEEKABLE replay start and the
+        # rotation drop the dispatched prefix; bounded: pruned to the
+        # watermark at every checkpoint. guarded by _stage_lock.
+        import collections as _collections
+        self._wal_tick_off: _collections.deque = _collections.deque()
+        self._wal_parked = False  # any parked record ever logged disables
+        #                           the offset/rotation optimizations
         # one compiled probe for the whole snapshot's scalar/vector reads:
         # the eager per-op form cost more than a full dispatch at serving
         # shapes (each eager op is its own device round trip on CPU)
         self._snap_probe = jax.jit(self._snap_probe_fn)
         self._refresh_snapshot()
+        if wal_path is not None:
+            self._open_wal(recover=recover)
 
     # ------------------------------------------------------------------
     # HTTP surface
@@ -253,6 +306,7 @@ class ServingScheduler(Service):
         self.httpd.route("GET", "/stats", self._handle_stats)
         self.httpd.route("GET", "/quote", self._handle_quote)
         self.httpd.route("GET", "/placed", self._handle_placed)
+        self.httpd.route("POST", "/admin/quiesce", self._handle_quiesce)
         # /metrics and /healthz ride the Service defaults (lifecycle.py):
         # the Prometheus render off the bridged Meter, and this service's
         # health() verdict below
@@ -391,6 +445,58 @@ class ServingScheduler(Service):
             "cluster": c, "id": jid, "status": s.job_status(c, jid),
             "snapshot_age_ms": round(s.age_ms(), 3)}).encode()
 
+    def _handle_quiesce(self, body: bytes, headers: dict):
+        """POST /admin/quiesce — maintenance drain for operators and the
+        chaos harness (tools/chaos.py): stop the loops, flush every sealed
+        tick, refresh the snapshot, write the final checkpoint (when
+        armed), and answer the drained truth. The HTTP surface keeps
+        serving queries off the frozen core; /healthz flips not-live.
+
+        The drain itself runs on a dedicated MAINTENANCE thread: the
+        device state's single-owner discipline survives (ownership passes
+        from the pacer/drive loops to that thread, never to an HTTP
+        handler), and the serve-sync contract — no device coercion on a
+        request thread — holds even for this endpoint; the handler only
+        signals and waits on host events. Exactly ONE maintenance thread
+        ever starts: concurrent/retried quiesce requests (including a
+        retry after a 503 timeout answer) attach to the in-flight drain
+        instead of spawning a second owner of the donated state."""
+        with self._quiesce_start_lock:
+            if self._quiesce_done is None:
+                self._quiesce_done = threading.Event()
+                self._quiesce_result = {}
+                threading.Thread(
+                    target=self._quiesce_and_report,
+                    args=(self._quiesce_result, self._quiesce_done),
+                    daemon=True, name=f"{self.name}-quiesce").start()
+            done, result = self._quiesce_done, self._quiesce_result
+        if not done.wait(timeout=300):
+            return 503, json.dumps(
+                {"Error": "quiesce still draining after 300s — retry to "
+                          "re-attach"}).encode()
+        if "Error" in result:
+            return 503, json.dumps(result).encode()
+        return 200, json.dumps(result).encode()
+
+    def _quiesce_and_report(self, result: dict, done) -> None:
+        """Maintenance-thread body of /admin/quiesce (never a handler)."""
+        try:
+            self.quiesce()
+            s = self._snap
+            result.update(
+                ticks_dispatched=self.ticks_dispatched,
+                dispatches=self.dispatches,
+                placed=s.placed, sim_t=s.sim_t,
+                staged_jobs=s.staged_jobs,
+                queue_depth=int(s.queue_depth.sum()),
+                running=int(s.running.sum()),
+                recovered_jobs=self.recovered_jobs,
+                checkpoint=self.checkpoint_path)
+        except Exception as e:  # wedged loop — surfaced, not raced
+            result["Error"] = str(e)
+        finally:
+            done.set()
+
     @staticmethod
     def _query_int(headers: dict, key: str, default: int) -> int:
         from urllib.parse import parse_qs
@@ -444,6 +550,7 @@ class ServingScheduler(Service):
         now = time.time() if self.track_latency else 0.0
         rejected: list[int] = []
         reasons: set[str] = set()
+        wal_recs: list[dict] = []
         with self._stage_lock:
             # the snapshot must be read under the SAME lock hold as the
             # unseen counters: _refresh_snapshot swaps the snapshot and
@@ -497,9 +604,35 @@ class ServingScheduler(Service):
                 room -= 1
                 if self.track_latency:
                     self._submit_wall[(c, jid)] = now
+                if self._wal is not None and not self._replaying:
+                    rec = {"c": c, "i": int(jid), "co": int(cores),
+                           "m": int(mem), "g": int(gpu), "du": int(dur),
+                           "dl": bool(delay), "t": int(stamp)}
+                    if parked:
+                        rec["p"] = 1
+                    wal_recs.append(rec)
             if rejected:
                 self._rejected += len(rejected)
             depth = int(snap.queue_depth.sum())
+            if wal_recs:
+                # durability BEFORE the ack: the fsync'd append happens
+                # under the same lock hold that staged the jobs, so WAL
+                # order is exactly staging order (what replay reconstructs)
+                # and a 200 can only reach the client for records already
+                # on disk
+                if (self.checkpoint_path is not None
+                        and (not self._wal_tick_off
+                             or self._wal_tick_off[-1][0] != self._stage_t)):
+                    # seek/rotation bookkeeping only matters when a
+                    # checkpoint can anchor it — without checkpoints the
+                    # WHOLE log is the recovery source (full replay from
+                    # a fresh state), growth is intrinsic to that config,
+                    # and the deque would just leak an entry per tick
+                    self._wal_tick_off.append(
+                        (self._stage_t, self._wal.tell()))
+                if any(r.get("p") for r in wal_recs):
+                    self._wal_parked = True
+                self._wal.append(wal_recs)
         if rejected:
             self.meter.add("submit_rejected", len(rejected))
         return rejected, reasons, len(jobs) - len(rejected), depth
@@ -539,6 +672,171 @@ class ServingScheduler(Service):
             self._sealed.append(self._open)
             self._open = [[] for _ in range(self.C)]
             self._stage_t += 1
+
+    # ------------------------------------------------------------------
+    # crash recovery: WAL + atomic checkpoints (services/wal.py)
+    # ------------------------------------------------------------------
+    def _open_wal(self, recover: bool) -> None:
+        """Restore (checkpoint + WAL-suffix replay) if asked and possible,
+        then open the log for appends — truncating any torn final record
+        so fresh appends never land after corrupt bytes. When the
+        checkpoint carries a matching-generation byte offset (and no
+        parked records muddy the tick-monotone prefix rule), the read
+        SEEKS to the live suffix instead of decoding the log's whole
+        lifetime; any mismatch falls back to the full scan — offsets are
+        an optimization, the replay watermark filter is the truth."""
+        from multi_cluster_simulator_tpu.core.checkpoint import load_extra
+        from multi_cluster_simulator_tpu.services import wal as walmod
+        extra: dict = {}
+        if self.checkpoint_path and os.path.exists(self.checkpoint_path):
+            try:
+                extra = load_extra(self.checkpoint_path)
+            except Exception as e:
+                self.logger.error(
+                    "checkpoint %s unreadable (%r); recovering from the "
+                    "WAL alone", self.checkpoint_path, e)
+                extra = {"_ckpt_unreadable": True}
+        start = gen = None
+        if recover and not extra.get("wal_parked"):
+            start = extra.get("wal_offset")
+            gen = extra.get("wal_gen")
+        records, offsets, good_off, torn = walmod.read_records(
+            self.wal_path, start=start, generation=gen)
+        self.wal_torn_tail = torn
+        if torn:
+            self.logger.warning(
+                "WAL %s has a torn final record (crash mid-append); "
+                "discarding the tail at byte %d", self.wal_path, good_off)
+        if recover and (records or (
+                self.checkpoint_path
+                and os.path.exists(self.checkpoint_path))):
+            self._recover(records, extra)
+        self._wal = walmod.WriteAheadLog(self.wal_path, fsync=True,
+                                         start_offset=good_off)
+        self._wal_parked = bool(extra.get("wal_parked")) or any(
+            r.get("p") for r in records)
+        if self.checkpoint_path is not None:
+            # reseed the per-tick offset table from the surviving suffix:
+            # a recovered process must keep pointing its NEXT checkpoint
+            # at the oldest not-yet-dispatched record, not the log's end
+            tick = self.cfg.tick_ms
+            seed: dict[int, int] = {}
+            for rec, off in zip(records, offsets):
+                dest = max((int(rec["t"]) + tick - 1) // tick, 1) - 1
+                if dest >= self.ticks_dispatched and dest not in seed:
+                    seed[dest] = off
+            with self._stage_lock:
+                self._wal_tick_off.extend(sorted(seed.items()))
+
+    def _recover(self, records: list[dict], extra: Optional[dict] = None
+                 ) -> None:
+        """Restart = restore the latest checkpoint + replay the WAL
+        suffix. Exactly-once against the checkpoint's dispatch watermark
+        T0 (``ticks_dispatched`` in the checkpoint header): a non-parked
+        record staged on tick k was dispatched iff k < T0 — WAL order is
+        staging order and dispatch consumes sealed ticks FIFO, so the
+        dispatched set is exactly the tick-< T0 prefix; parked records
+        are applied at dispatch edges regardless of tick, so the header's
+        ``parked_applied`` count skips the applied prefix instead.
+        Calling this again over the same files reproduces the same state
+        (pure function of checkpoint + WAL), and a second in-process call
+        is a no-op because the replayed jobs' ticks are already staged
+        (tests/test_faults.py pins both)."""
+        from multi_cluster_simulator_tpu.core.checkpoint import load_state
+        import jax.numpy as jnp
+        extra = extra or {}
+        t0_ticks = 0
+        parked_skip = 0
+        if (self.checkpoint_path and os.path.exists(self.checkpoint_path)
+                and not extra.get("_ckpt_unreadable")):
+            self._state = load_state(self.checkpoint_path, self._state)
+            # donation discipline: loaded leaves are distinct host arrays,
+            # but clone anyway so no two leaves can alias one buffer
+            self._state = jax.tree.map(jnp.copy, self._state)
+            t0_ticks = int(extra.get("ticks_dispatched", 0))
+            parked_skip = int(extra.get("parked_applied", 0))
+            self.ticks_dispatched = t0_ticks
+            self.dispatches = int(extra.get("dispatches", 0))
+            self._parked_applied = parked_skip
+        with self._stage_lock:
+            self._stage_t = t0_ticks
+        self._refresh_snapshot()
+        tick = self.cfg.tick_ms
+        replayed = 0
+        self._replaying = True
+        try:
+            for rec in records:
+                stamp = int(rec["t"])
+                if rec.get("p"):
+                    if parked_skip > 0:
+                        parked_skip -= 1
+                        continue
+                    # parked rows sit in a queue the policy never drains;
+                    # their stamp is advisory — restage on the open tick
+                    ta = None
+                else:
+                    dest = max((stamp + tick - 1) // tick, 1) - 1
+                    if dest < t0_ticks:
+                        continue  # already in the checkpointed state
+                    while self._staged_ticks() < dest:
+                        self.seal_tick()
+                    ta = stamp
+                rej, _r, _a, _d = self._stage(
+                    [(int(rec["c"]), int(rec["i"]), int(rec["co"]),
+                      int(rec["m"]), int(rec["g"]), int(rec["du"]),
+                      bool(rec["dl"]))], ta=ta, live_bounds=False)
+                if rej:
+                    raise RuntimeError(
+                        f"WAL replay: acked job {rec['i']} rejected at "
+                        "restage — staging bounds shrank under recovery?")
+                replayed += 1
+        finally:
+            self._replaying = False
+        self.recovered_jobs = replayed
+        if replayed or t0_ticks:
+            self.logger.info(
+                "recovered: checkpoint at %d dispatched ticks + %d WAL "
+                "jobs replayed (%d parked applied pre-crash)",
+                t0_ticks, replayed, self._parked_applied)
+
+    def _save_checkpoint(self) -> None:
+        """Atomic device-state checkpoint (core/checkpoint.py: tmp +
+        rename) with the recovery watermarks in the header. Runs on the
+        dispatch owner's thread between dispatches, so the state snapshot
+        is consistent by construction.
+
+        Also the WAL's growth bound: the header records the byte offset
+        of the first record the watermark has not covered (recovery seeks
+        there instead of decoding the log's lifetime), and once the
+        dispatched prefix exceeds ``wal_rotate_bytes`` the log is
+        compacted to the live suffix (WriteAheadLog.rotate — a fresh
+        generation, so a checkpoint from before a crash mid-rotation
+        falls back to the full scan). Both are disabled once a parked
+        record enters the log: parked application order is by dispatch
+        edge, not tick, so only the full-list skip count is correct."""
+        from multi_cluster_simulator_tpu.core.checkpoint import save_state
+        from multi_cluster_simulator_tpu.services.wal import HEADER_LEN
+        extra = {"ticks_dispatched": self.ticks_dispatched,
+                 "parked_applied": self._parked_applied,
+                 "dispatches": self.dispatches}
+        if self._wal is not None:
+            with self._stage_lock:
+                # replay starts at the first tick the watermark missed;
+                # fully-covered entries are never needed again
+                while (self._wal_tick_off
+                       and self._wal_tick_off[0][0] < self.ticks_dispatched):
+                    self._wal_tick_off.popleft()
+                start = (self._wal_tick_off[0][1] if self._wal_tick_off
+                         else self._wal.tell())
+                if (not self._wal_parked
+                        and start - HEADER_LEN > self.wal_rotate_bytes):
+                    delta = self._wal.rotate(start)
+                    self._wal_tick_off = type(self._wal_tick_off)(
+                        (tk, off - delta) for tk, off in self._wal_tick_off)
+                    start -= delta
+                extra.update(wal_offset=start, wal_gen=self._wal.generation,
+                             wal_parked=self._wal_parked)
+        save_state(self._state, self.checkpoint_path, extra=extra)
 
     # ------------------------------------------------------------------
     # dispatch (single owner: the drive thread or the deterministic driver)
@@ -613,6 +911,7 @@ class ServingScheduler(Service):
                 self._state, io = self._run_io(self._state, rows, counts)
         self.ticks_dispatched += T
         self.dispatches += 1
+        self._parked_applied += len(parked)
         self.batch_jobs.append(n_jobs)
         self._batch_n += 1
         self._batch_sum += n_jobs
@@ -631,6 +930,9 @@ class ServingScheduler(Service):
                            int(np.asarray(io.ret_valid).sum()))
         if self.dispatches % self.snapshot_every == 0:
             self._refresh_snapshot()
+        if (self.checkpoint_path is not None
+                and self.dispatches % self.checkpoint_every == 0):
+            self._save_checkpoint()
         return n_jobs
 
     def dispatch_sealed(self) -> int:
@@ -645,7 +947,8 @@ class ServingScheduler(Service):
             n += self._dispatch(tail)
         return n
 
-    _DROP_KEYS = ("queue", "msgs", "run_full", "vslot", "carve", "ingest")
+    _DROP_KEYS = ("queue", "msgs", "run_full", "vslot", "carve", "ingest",
+                  "failed")
 
     @staticmethod
     def _snap_probe_fn(s):
@@ -749,6 +1052,11 @@ class ServingScheduler(Service):
         server itself still answers (the whole point: the transport
         outliving the core must be VISIBLE)."""
         checks = {}
+        if self._wedged:
+            # unconditional (survives _started flipping off): a wedged
+            # stop must read as unhealthy, never as a clean shutdown
+            checks["shutdown_wedged"] = False
+            checks["wedged_thread"] = self._wedged
         if self.pacer and self._started:
             checks["pacer_alive"] = (self._pacer_thread is not None
                                      and self._pacer_thread.is_alive())
@@ -811,22 +1119,29 @@ class ServingScheduler(Service):
         a drive thread wedged past the join timeout still owns the
         donated device state, and dispatching from this thread too would
         make two concurrent owners (donated-buffer reuse, acked jobs
-        lost) — exactly the wedge /healthz exists to surface, so raise
-        it instead of racing it."""
+        lost) — exactly the wedge /healthz exists to surface, so flip
+        the surface to 503 and raise instead of racing it."""
         self._stop.set()
         for th in (self._pacer_thread, self._drive_thread):
             if th is not None:
-                th.join(timeout=30)
+                th.join(timeout=self.stop_join_timeout_s)
                 if th.is_alive():
+                    self._wedged = th.name  # /healthz answers 503 now
+                    self.logger.error(
+                        "quiesce: %s did not exit within %.1fs — wedged; "
+                        "/healthz flipped to 503", th.name,
+                        self.stop_join_timeout_s)
                     raise RuntimeError(
-                        f"quiesce: {th.name} did not exit within 30s — "
-                        "the loop is wedged (it still owns the device "
-                        "state, so no drain flush can run); /healthz is "
-                        "reporting it")
+                        f"quiesce: {th.name} did not exit within "
+                        f"{self.stop_join_timeout_s:.0f}s — the loop is "
+                        "wedged (it still owns the device state, so no "
+                        "drain flush can run); /healthz is reporting it")
         self._pacer_thread = None
         self._drive_thread = None
         self.dispatch_sealed()
         self._refresh_snapshot()
+        if self.checkpoint_path is not None:
+            self._save_checkpoint()  # the drained truth, durably
         # a deliberately frozen core is not a wedged refresh loop: the
         # final snapshot above is the drained truth and stays servable,
         # so disarm the staleness bound (health() still reports the
@@ -836,9 +1151,25 @@ class ServingScheduler(Service):
     def on_shutdown(self) -> None:
         self._stop.set()
         if self._pacer_thread is not None:
-            self._pacer_thread.join(timeout=10)
+            self._pacer_thread.join(timeout=self.pacer_join_timeout_s)
+            if self._pacer_thread.is_alive():
+                self._wedged = self._pacer_thread.name
         if self._drive_thread is not None:
-            self._drive_thread.join(timeout=30)
+            self._drive_thread.join(timeout=self.stop_join_timeout_s)
+            if self._drive_thread.is_alive():
+                self._wedged = self._drive_thread.name
+        if self._wedged:
+            # wedged-thread honesty: a loop that never exited still owns
+            # the donated device state — a flush here would make two
+            # concurrent owners. Log it, flip /healthz to 503 (the
+            # lifecycle keeps the diagnostic surface up — Service.shutdown
+            # checks wedged()), and do NOT pretend shutdown succeeded.
+            self.logger.error(
+                "shutdown: %s did not exit within its join timeout — "
+                "wedged; skipping the final flush (the wedged loop still "
+                "owns the device state) and flipping /healthz to 503",
+                self._wedged)
+            return
         if self.pacer:
             # final flush AFTER both threads have exited: a flush inside
             # the drive loop could race the still-running pacer and
@@ -847,9 +1178,14 @@ class ServingScheduler(Service):
             # the caller thread owns the state (both owners joined), so
             # every sealed tick is dispatched exactly once. Anything
             # still OPEN was never sealed into virtual time and stays
-            # staged (documented).
+            # staged (durable in the WAL when one is armed — recovery
+            # restages it).
             self.dispatch_sealed()
             self._refresh_snapshot()
+        if self.checkpoint_path is not None:
+            self._save_checkpoint()
+        if self._wal is not None:
+            self._wal.close()
 
     def _pacer_loop(self) -> None:
         """Seal staging ticks on the virtual-time cadence (``speed``
@@ -872,8 +1208,13 @@ class ServingScheduler(Service):
         # an 8-window lead measured 4x lower sustained admission
         max_lead = 2 * self.window
         t0 = time.time()
+        # rebase on the staging clock at loop start: a RECOVERED service
+        # resumes with _stage_t already at the checkpoint watermark, and
+        # an elapsed-from-zero target would stall sealing until wall time
+        # caught up with the whole pre-crash history
+        base = self._staged_ticks()
         while not self._stop.is_set():
-            due = min(int((time.time() - t0) / period),
+            due = min(base + int((time.time() - t0) / period),
                       self.ticks_dispatched + max_lead)
             while self._staged_ticks() < due:
                 self.seal_tick()

@@ -32,6 +32,9 @@ from multi_cluster_simulator_tpu.config import (
 from multi_cluster_simulator_tpu.ops import queues as Q
 from multi_cluster_simulator_tpu.ops import sizing
 from multi_cluster_simulator_tpu.services import rpc, telemetry
+from multi_cluster_simulator_tpu.services.backoff import (
+    CircuitBreaker, jittered_backoff_ms,
+)
 from multi_cluster_simulator_tpu.services.lifecycle import Service
 from multi_cluster_simulator_tpu.services.proto import (
     resource_channel_pb2 as rc_pb,
@@ -82,8 +85,20 @@ class TraderService(Service):
         self._serial = random.getrandbits(31) or 1  # s.id = rand.Uint32()
         # peer cache + trade counters are shared between the monitor thread,
         # gRPC handler threads, and shutdown
-        self._peer_lock = threading.Lock()  # guards: _peer_clients, trades_won, trades_sold
+        self._peer_lock = threading.Lock()  # guards: _peer_clients, _breakers, trades_won, trades_sold
         self._peer_clients: dict[str, rpc.TraderClient] = {}
+        # Peer RPC resilience: bounded per-call retries with jittered
+        # exponential backoff, and one circuit breaker per peer — a dead
+        # trader used to stall EVERY monitor round for the full
+        # as_completed collect-window timeout and was re-dialed forever;
+        # now it costs `breaker_fail_threshold` rounds, then opens and is
+        # skipped until a half-open probe (on the monitor cadence — the
+        # reset horizon) succeeds. Breaker state surfaces in /metrics
+        # (peer_breakers_open gauge) and the /healthz detail.
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self.rpc_attempts = 2  # bounded per-call retry budget
+        self.rpc_backoff_base_ms = 50.0 / speed
+        self.breaker_fail_threshold = 3
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix=f"{name}-rpc")
         self.trades_won = 0
@@ -209,7 +224,13 @@ class TraderService(Service):
         """Trade (trader.go:193-278): fan RequestResource out to all peer
         traders, collect approvals in the window, walk offers cheapest-first
         calling ApproveContract until a seller carves, then hand the node to
-        our scheduler."""
+        our scheduler.
+
+        Resilience (no reference analogue — Go re-dials dead peers every
+        round): peers whose circuit breaker is OPEN are skipped before any
+        socket is touched, each RPC gets a bounded retry budget with
+        jittered exponential backoff (``_rpc_call``), and every outcome
+        feeds the peer's breaker."""
         if self.registry is None:
             return False
         try:
@@ -219,12 +240,20 @@ class TraderService(Service):
             return False
         if not peers:
             return False
+        allowed = [u for u in peers if self._breaker(u).allow()]
+        skipped = len(peers) - len(allowed)
+        if skipped:
+            self.meter.add("peer_rpc_skipped_open", skipped)
+        self._export_breaker_gauges()
+        if not allowed:
+            return False
         window = TRADE_COLLECT_WINDOW_S / self.speed
         # wrap_ctx carries the Trade span context onto the pool threads so
         # each RequestResource RPC propagates it to the seller
         futs = {self._pool.submit(
-            telemetry.wrap_ctx(self._peer(u).request_resource), contract,
-            max(window, 0.5)): u for u in peers}
+            telemetry.wrap_ctx(self._rpc_call), u,
+            lambda u_=u: self._peer(u_).request_resource(
+                contract, max(window, 0.5))): u for u in allowed}
         offers = []
         try:
             for fut in as_completed(futs, timeout=max(window, 0.5) + 1):
@@ -241,7 +270,8 @@ class TraderService(Service):
         offers.sort(key=lambda o: o[0].price)
         for resp, url in offers:
             try:
-                node = self._peer(url).approve_contract(resp)
+                node = self._rpc_call(
+                    url, lambda: self._peer(url).approve_contract(resp))
             except Exception:
                 continue  # heap fall-through (trader.go:265-276)
             try:
@@ -255,6 +285,67 @@ class TraderService(Service):
                              node.cores, node.memory, url)
             return True
         return False
+
+    def _rpc_call(self, url: str, fn):
+        """One peer RPC under the retry + breaker discipline: up to
+        ``rpc_attempts`` tries with jittered exponential backoff between
+        them, every outcome recorded into the peer's breaker (a half-open
+        probe that fails re-opens it immediately — no second attempt).
+        Runs concurrently on the fan-out pool threads, so the jitter rng
+        is per-call (numpy Generators are not thread-safe; OS-entropy
+        seeding is exactly right for decorrelation)."""
+        br = self._breaker(url)
+        rng = np.random.default_rng()
+        last: Exception = RuntimeError("no attempt ran")
+        for attempt in range(self.rpc_attempts):
+            try:
+                out = fn()
+                br.record_success()
+                return out
+            except Exception as e:
+                last = e
+                br.record_failure()
+                self.meter.add("peer_rpc_failures", 1)
+                if attempt + 1 >= self.rpc_attempts or not br.allow():
+                    break
+                delay = jittered_backoff_ms(
+                    attempt, self.rpc_backoff_base_ms,
+                    1000.0 / self.speed, rng) / 1000.0
+                if self._stop.wait(delay):
+                    break
+        raise last
+
+    def _breaker(self, url: str) -> CircuitBreaker:
+        with self._peer_lock:
+            if url not in self._breakers:
+                # half-open probe horizon = the monitor cadence: the next
+                # round after the reset window admits exactly one probe
+                self._breakers[url] = CircuitBreaker(
+                    fail_threshold=self.breaker_fail_threshold,
+                    reset_after_s=self.tcfg.monitor_period_ms / 1000.0
+                    / self.speed)
+            return self._breakers[url]
+
+    def _export_breaker_gauges(self) -> None:
+        with self._peer_lock:
+            states = {u: b.state for u, b in self._breakers.items()}
+        self.meter.set_gauge(
+            "peer_breakers_open",
+            float(sum(1 for s in states.values()
+                      if s != CircuitBreaker.CLOSED)))
+        self.meter.set_gauge("peer_breakers_known", float(len(states)))
+
+    def health(self) -> tuple[bool, dict]:
+        """/healthz: the trader itself stays healthy when peers die (that
+        is the point of the breakers) — but the per-peer breaker states
+        ride the detail so an operator sees WHICH peers are being
+        skipped."""
+        with self._peer_lock:
+            states = {u: b.state for u, b in self._breakers.items()}
+        open_n = sum(1 for s in states.values()
+                     if s != CircuitBreaker.CLOSED)
+        return True, {"peer_breakers": states,
+                      "peer_breakers_open": open_n}
 
     def _peer(self, url: str) -> rpc.TraderClient:
         """Lazily-built peer client cache (TraderClients, trader.go:33);

@@ -26,6 +26,7 @@ import numpy as np
 
 from multi_cluster_simulator_tpu.config import WorkloadConfig
 from multi_cluster_simulator_tpu.services import httpd
+from multi_cluster_simulator_tpu.services.backoff import jittered_backoff_ms
 from multi_cluster_simulator_tpu.services.lifecycle import Service
 from multi_cluster_simulator_tpu.services.scheduler_host import job_to_json
 
@@ -45,6 +46,14 @@ class WorkloadClientService(Service):
         self.max_job_mem = 0
         self.jobs_sent = 0
         self.acks = 0
+        # client-side backoff discipline: a 503 quote's RetryAfterMs is a
+        # BASE delay, not a fixed sleep — retries are jittered exponential
+        # under a bounded attempt budget, and exhaustion is counted +
+        # logged instead of spinning forever
+        self.retry_attempts = 8
+        self.retries_503 = 0
+        self.conn_retries = 0  # transport failures (dead/restarting server)
+        self.retries_exhausted = 0
         self._rng = np.random.default_rng(wcfg.seed)
         # the ack counter is bumped by HTTP handler threads and read by the
         # generator thread / tests
@@ -96,11 +105,43 @@ class WorkloadClientService(Service):
         return job_to_json(self.jobs_sent, cores, mem, dur_s * 1000)
 
     def _send_one(self, payload: dict) -> None:
-        status, _ = httpd.post_bytes(
-            self.scheduler_url + "/delay", json.dumps(payload).encode(),
-            content_type="application/json")
-        if status != 200:
-            self.logger.error("job %s rejected: %s", payload["Id"], status)
+        """POST one job; a 503 back-pressure quote honors RetryAfterMs and
+        a transport failure (status 0 — a dead or restarting scheduler,
+        including one killed mid-response, which httpd maps to 0) is
+        equally retryable, both with jittered exponential backoff under
+        the bounded attempt budget (services/backoff.py)."""
+        body = json.dumps(payload).encode()
+        for attempt in range(self.retry_attempts):
+            status, resp = httpd.post_bytes(
+                self.scheduler_url + "/delay", body,
+                content_type="application/json")
+            if status == 200:
+                return
+            if status not in (0, 503):
+                self.logger.error("job %s rejected: %s", payload["Id"],
+                                  status)
+                return
+            quote_ms = 100.0
+            if status == 503:
+                self.retries_503 += 1
+                try:
+                    # the server's quote is already wall-scaled (it
+                    # divides by its own speed): used as-is for the base
+                    quote_ms = float(json.loads(resp)["RetryAfterMs"])
+                except (ValueError, TypeError, KeyError):
+                    pass
+            else:
+                self.conn_retries += 1
+            delay = jittered_backoff_ms(
+                attempt, max(quote_ms, 1.0), 5_000.0 / self.speed,
+                self._rng) / 1000.0
+            if self._stop.wait(delay):
+                return
+        self.retries_exhausted += 1
+        self.logger.error(
+            "job %s: retry budget (%d attempts) exhausted against "
+            "back-pressure/transport failures — giving up", payload["Id"],
+            self.retry_attempts)
 
     def _send_jobs(self) -> None:
         if self.wcfg.arrival == "weibull":

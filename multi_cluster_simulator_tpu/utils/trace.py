@@ -69,7 +69,8 @@ def total_drops(state: SimState) -> dict:
 
     d = state.drops
     out = {k: int(np.asarray(getattr(d, k)).sum())
-           for k in ("queue", "msgs", "run_full", "vslot", "carve", "ingest")}
+           for k in ("queue", "msgs", "run_full", "vslot", "carve", "ingest",
+                     "failed")}
     out["narrow"] = overflow_total(state)
     return out
 

@@ -168,6 +168,7 @@ def tick_arrivals_device(key, t, n_clusters: int, k_max: int, rate,
     vals = {"id": ids, "cores": cores, "mem": mem, "gpu": gpu, "dur": dur,
             "enq_t": tt, "owner": jnp.full((C, K), int(Q.OWN), jnp.int32),
             "rec_wait": jnp.zeros((C, K), jnp.int32),
-            "jclass": F.job_class(cores, gpu).astype(jnp.int32)}
+            "jclass": F.job_class(cores, gpu).astype(jnp.int32),
+            "retries": jnp.zeros((C, K), jnp.int32)}
     rows = jnp.stack([vals[n] for n in F.QUEUE_FIELDS], axis=-1)
     return rows, counts

@@ -73,7 +73,7 @@ def test_carve_placeholder_miss_no_leak():
     # seller nodes under sane carve: 16 from node 0, 4 from node 1), and its
     # WaitTime policy is broken so the fast-node path fires
     l1_data = np.asarray(state.l1.data).copy()
-    l1_data[0, 0] = [1, 20, 10_000, 0, 5_000, 0, -1, 0, 1]  # jclass 1: core-heavy
+    l1_data[0, 0] = [1, 20, 10_000, 0, 5_000, 0, -1, 0, 1, 0]  # jclass 1: core-heavy
     l1_count = np.array([1, 0], np.int32)
     tr = state.trader.replace(
         snap_avg_wait=jnp.asarray(np.array([700_000.0, 0.0], np.float32)))
@@ -112,7 +112,7 @@ def test_vslot_miss_counted():
              uniform_cluster(2, 2, cores=16, memory=8_000)]
     state = init_state(cfg, specs)
     l1_data = np.asarray(state.l1.data).copy()
-    l1_data[0, 0] = [1, 4, 1_000, 0, 5_000, 0, -1, 0, 0]
+    l1_data[0, 0] = [1, 4, 1_000, 0, 5_000, 0, -1, 0, 0, 0]
     l1_count = np.array([1, 0], np.int32)
     # buyer's only virtual slot is already active (a previous trade)
     act = np.asarray(state.node_active).copy()

@@ -1,0 +1,725 @@
+"""The fault plane (faults/, PR 13): parity matrix under churn, adversarial
+fault schedules, retry/drop accounting, the serving tier's WAL crash
+recovery, the wedged-shutdown honesty flags, and the retry/breaker
+primitives.
+
+The load-bearing contract: failure is DATA riding the state — invisible to
+every execution strategy (dense vs compressed time, wide vs compact
+layout, whole vs ragged-chunked streams, 1 vs 8 devices), and the serving
+tier's 200-ack is durable across kill -9 (checkpoint + WAL replay
+reconstructs a state bit-identical to an uninterrupted run)."""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from multi_cluster_simulator_tpu.config import (
+    FaultConfig, PolicyKind, SimConfig,
+)
+from multi_cluster_simulator_tpu.core.compact import derive_plan, to_wide
+from multi_cluster_simulator_tpu.core.engine import (
+    Engine, pack_arrivals_by_tick, pack_arrivals_chunks,
+)
+from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.utils.trace import (
+    check_conservation, total_drops,
+)
+from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+
+TICK = 1_000
+
+
+def _tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def _cfg(C=4, faults=None, **kw):
+    base = dict(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                queue_capacity=64, max_running=64, max_arrivals=40,
+                max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=0)
+    base.update(kw)
+    if faults is not None:
+        base["faults"] = faults
+    return SimConfig(**base)
+
+
+def _specs(C):
+    return [uniform_cluster(c + 1, 5) for c in range(C)]
+
+
+def _stream(C, jobs=40, horizon=60_000, seed=3, max_dur=20_000):
+    return uniform_stream(C, jobs, horizon, max_cores=8, max_mem=6_000,
+                          max_dur_ms=max_dur, seed=seed)
+
+
+_CHURN = FaultConfig(enabled=True, mode="generative", mttf_ms=20_000,
+                     mttr_ms=4_000, seed=5, max_retries=8)
+
+
+# ---------------------------------------------------------------------------
+# faults-off == baseline; the enabled-but-eventless plane is a no-op
+# ---------------------------------------------------------------------------
+
+def test_faults_off_is_baseline():
+    C, T = 4, 80
+    cfg = _cfg(C)
+    arr = _stream(C)
+    ta = pack_arrivals_by_tick(arr, T, TICK)
+    off = Engine(cfg).run_jit()(init_state(cfg, _specs(C)), ta, T)
+    # an ENABLED plane with an empty trace schedule must leave every
+    # shared leaf bitwise identical — the phase is a no-op without events
+    cfg_empty = _cfg(C, faults=dataclasses.replace(_CHURN, mode="trace"))
+    empty = Engine(cfg_empty).run_jit()(
+        init_state(cfg_empty, _specs(C), fault_events=[]), ta, T)
+    assert _tree_equal(off.replace(faults=None), empty.replace(faults=None))
+    fs = off.faults
+    assert bool(np.asarray(fs.health).all())
+    assert int(np.asarray(fs.kills).sum()) == 0
+    assert total_drops(off)["failed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the parity matrix under generative churn: compact x compression x ragged
+# chunks x the 8-device mesh, every cell bit-identical to dense/wide/1-dev
+# ---------------------------------------------------------------------------
+
+def test_parity_matrix_under_churn():
+    C, T = 8, 80
+    cfg = _cfg(C, faults=_CHURN)
+    specs = _specs(C)
+    arr = _stream(C)
+    ta = pack_arrivals_by_tick(arr, T, TICK)
+    eng = Engine(cfg)
+    fn = eng.run_jit()
+    ref = fn(init_state(cfg, specs), ta, T)
+    kills = int(np.asarray(ref.faults.kills).sum())
+    assert kills > 0, "churn config never killed a job — the matrix is vacuous"
+    assert int(np.asarray(ref.faults.requeues).sum()) > 0
+    check_conservation(ref)
+
+    # compact storage (retries narrows to i8 via the plan)
+    plan = derive_plan(cfg, specs, arr)
+    assert dict(plan.queue)["retries"] == "int8"
+    out = fn(init_state(cfg, specs, plan=plan), ta, T)
+    assert int(np.asarray(out.run.ovf).sum()) == 0
+    assert _tree_equal(to_wide(out), ref), "compact diverged under churn"
+
+    # event-compressed time (the leap bound folds in fault events)
+    out_c, _stats = eng.run_compressed_jit()(init_state(cfg, specs), ta, T)
+    assert _tree_equal(out_c, ref), "compressed diverged under churn"
+
+    # ragged chunk pipeline (uneven chunk boundary mid-outage)
+    sizes = [33, 29, T - 62]
+    st = init_state(cfg, specs)
+    for ch, n in zip(pack_arrivals_chunks(arr, sizes, TICK), sizes):
+        st = fn(st, ch, n)
+    assert _tree_equal(st, ref), "chunked diverged under churn"
+
+    # 8-device mesh, compact + compression composed
+    from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device CPU mesh (conftest)")
+    sh = ShardedEngine(cfg, make_mesh(8))
+    out_m = sh.run_fn(T, tick_indexed=True)(
+        sh.shard_state(init_state(cfg, specs)), sh.shard_arrivals(ta))
+    assert _tree_equal(out_m, ref), "8-device mesh diverged under churn"
+    out_x, _ = sh.run_fn(T, tick_indexed=True, time_compress=True)(
+        sh.shard_state(init_state(cfg, specs, plan=plan)),
+        sh.shard_arrivals(ta))
+    assert _tree_equal(to_wide(out_x), ref), \
+        "mesh+compact+compressed diverged under churn"
+
+
+def test_obs_fault_counters_ride_the_buffer():
+    """The metrics plane's churn counters: the harvested buffer's fault
+    totals equal the state's own cumulative counters, and the compressed
+    harvest matches the dense one bit for bit (the leap never jumps a
+    fault event)."""
+    from multi_cluster_simulator_tpu.obs import device as obs_dev
+
+    C, T = 4, 80
+    cfg = _cfg(C, faults=_CHURN)
+    specs = _specs(C)
+    ta = pack_arrivals_by_tick(_stream(C), T, TICK)
+    eng = Engine(cfg)
+    mb0 = obs_dev.metrics_init(init_state(cfg, specs))
+    out, mb = jax.jit(eng.run, static_argnums=(2,))(
+        init_state(cfg, specs), ta, T, None, mb0)
+    h = obs_dev.harvest(mb)
+    assert h["fault_kills"] == int(np.asarray(out.faults.kills).sum()) > 0
+    assert h["fault_requeues"] == int(np.asarray(out.faults.requeues).sum())
+    assert h["node_down_ms"] == int(np.asarray(out.faults.down_ms).sum()) > 0
+    out_c, _st, mb_c = jax.jit(eng.run_compressed, static_argnums=(2,))(
+        init_state(cfg, specs), ta, T, None,
+        obs_dev.metrics_init(init_state(cfg, specs)))
+    assert _tree_equal(mb_c.replace(leap_hist=None),
+                       mb.replace(leap_hist=None))
+
+
+# ---------------------------------------------------------------------------
+# adversarial trace schedules
+# ---------------------------------------------------------------------------
+
+def _one_cluster_trace(events, T=30, jobs=6, max_retries=3, dur=60_000):
+    """One cluster under an explicit fault schedule, with long-running jobs
+    (they outlive the horizon, so any completion-shaped change is the
+    fault plane's doing). Returns the final state."""
+    fc = FaultConfig(enabled=True, mode="trace", max_retries=max_retries,
+                     max_events=4)
+    cfg = _cfg(1, faults=fc)
+    arr = uniform_stream(1, jobs, 2_000, max_cores=4, max_mem=2_000,
+                         max_dur_ms=dur, seed=9)
+    # floor durations: a zero-length job would complete before any fault
+    arr = arr.replace(dur=jnp.maximum(arr.dur, dur // 2))
+    ta = pack_arrivals_by_tick(arr, T, TICK)
+    return Engine(cfg).run_jit()(
+        init_state(cfg, _specs(1), fault_events=events), ta, T)
+
+
+def test_trace_kill_requeues_with_budget_bump():
+    # node 0 fails at 5 s, repairs at 8 s
+    out = _one_cluster_trace([(0, 0, 5_000, 8_000)])
+    fs = out.faults
+    assert int(np.asarray(fs.kills)[0]) > 0
+    assert int(np.asarray(fs.requeues)[0]) == int(np.asarray(fs.kills)[0])
+    assert int(np.asarray(fs.down_ms)[0]) == 3_000
+    assert bool(np.asarray(fs.health).all())  # repaired by the horizon
+    assert int(np.asarray(fs.n_fails)[0, 0]) == 1
+    # requeued rows carry the bumped budget: every re-placed job's run row
+    # shows retries == 1
+    run = out.run
+    act = np.asarray(run.active)[0]
+    assert act.any()
+    assert (np.asarray(run.retries)[0][act] == 1).all()
+    assert total_drops(out)["failed"] == 0
+    check_conservation(out)
+
+
+def test_trace_fail_at_t0():
+    out = _one_cluster_trace([(0, n, 0, 60_000) for n in range(5)])
+    # every node down from the first tick and never repaired inside the
+    # horizon: nothing can place, nothing is killed (nothing ever ran)
+    assert not bool(np.asarray(out.faults.health)[0, :5].any())
+    assert int(np.asarray(out.placed_total).sum()) == 0
+    assert int(np.asarray(out.faults.kills).sum()) == 0
+    assert bool((np.asarray(out.node_free)[0, :5] == 0).all())
+
+
+def test_trace_same_tick_fail_repair_is_zero_length_outage():
+    out = _one_cluster_trace([(0, 0, 5_000, 5_000)])
+    fs = out.faults
+    # the outage still kills (failures apply before repairs)...
+    assert int(np.asarray(fs.kills)[0]) > 0
+    # ...but closes within the tick: zero downtime, node healthy + full
+    assert int(np.asarray(fs.down_ms)[0]) == 0
+    assert int(np.asarray(fs.n_fails)[0, 0]) == 1
+    assert bool(np.asarray(fs.health).all())
+    check_conservation(out)
+
+
+def test_trace_repair_before_fail_collapses():
+    # malformed interval (repair strictly before fail): one-tick outage at
+    # the fail tick, deterministic, never wedges the node down
+    out = _one_cluster_trace([(0, 0, 5_000, 3_000)])
+    fs = out.faults
+    assert bool(np.asarray(fs.health).all())
+    assert int(np.asarray(fs.n_fails)[0, 0]) == 1
+    assert int(np.asarray(fs.down_ms)[0]) == 0
+    check_conservation(out)
+
+
+def test_retry_budget_exhaustion_counts_failed():
+    out = _one_cluster_trace([(0, n, 5_000, 6_000) for n in range(5)],
+                             max_retries=0)
+    # budget 0: every killed job drops into drops.failed, none requeue
+    fs = out.faults
+    kills = int(np.asarray(fs.kills)[0])
+    assert kills > 0
+    assert int(np.asarray(fs.requeues)[0]) == 0
+    assert total_drops(out)["failed"] == kills
+
+
+def test_killed_foreign_job_requeues_into_lent():
+    """A killed job a peer lent me (owner >= 0) goes back to the LENT
+    queue — where foreign jobs live in the reference — never into the
+    ready/wait flow where a second borrow would overwrite its owner."""
+    from multi_cluster_simulator_tpu.ops import queues as Q
+    from multi_cluster_simulator_tpu.ops import runset as R
+
+    fc = FaultConfig(enabled=True, mode="trace", max_retries=3, max_events=2)
+    cfg = _cfg(2, faults=fc)
+    # ALL of cluster 0's nodes fail (repair beyond the horizon) so the
+    # requeued rows stay visibly parked in their queues
+    state = init_state(cfg, _specs(2), fault_events=[
+        (0, n, 2_000, 60_000) for n in range(cfg.total_nodes)])
+    # cluster 0 hosts a foreign job for cluster 1 on node 0, plus one of
+    # its own — both long enough to outlive the fault
+    rows = {
+        1: R.make_row(90_000, 0, 2, 100, 0, 71, 1, 89_000, 1_000),
+        0: R.make_row(90_000, 0, 3, 200, 0, 72, int(np.asarray(Q.OWN)),
+                      89_000, 1_000),
+    }
+    data = np.asarray(state.run.data).copy()
+    act = np.asarray(state.run.active).copy()
+    for slot, row in rows.items():
+        data[0, slot] = np.asarray(row)
+        act[0, slot] = True
+    state = state.replace(
+        run=state.run.replace(data=jnp.asarray(data),
+                              active=jnp.asarray(act)),
+        node_free=state.node_free.at[0, 0, 0].add(-5)
+        .at[0, 0, 1].add(-300))
+    arr = uniform_stream(2, 1, 1, max_cores=1, max_mem=1, max_dur_ms=1,
+                         seed=0)
+    arr = arr.replace(n=jnp.zeros_like(arr.n))  # no arrivals: churn only
+    ta = pack_arrivals_by_tick(arr, 5, TICK)
+    out = Engine(cfg).run_jit()(state, ta, 5)
+    assert int(np.asarray(out.faults.kills)[0]) == 2
+    lent_ids = np.asarray(out.lent.id)[0][:int(np.asarray(out.lent.count)[0])]
+    assert lent_ids.tolist() == [71]  # the foreign job is back in lent
+    lent_hot = np.asarray(out.lent.id)[0] == 71
+    assert (np.asarray(out.lent.owner)[0][lent_hot] == 1).all()
+    assert (np.asarray(out.lent.retries)[0][lent_hot] == 1).all()
+    # the OWN job went to the FIFO ingest flow (ready -> wait on the
+    # capacity-less cluster), never to lent
+    own_pool = np.concatenate([
+        np.asarray(out.ready.id)[0][:int(np.asarray(out.ready.count)[0])],
+        np.asarray(out.wait.id)[0][:int(np.asarray(out.wait.count)[0])]])
+    assert 72 in own_pool.tolist()
+    check_conservation(out)
+
+
+def test_fail_node_hosting_borrowed_vnode():
+    """Fail the slot a traded virtual node occupies: its job is killed +
+    requeued, the slot cannot be reclaimed by a new attach mid-outage
+    (the health gate in host_ops/market), and repair restores the vnode
+    empty."""
+    from multi_cluster_simulator_tpu.services import host_ops
+
+    fc = FaultConfig(enabled=True, mode="trace", max_retries=3, max_events=4)
+    # one tiny physical node (2 cores) + one virtual slot; the job below
+    # only fits the vnode
+    cfg = _cfg(1, faults=fc, max_nodes=1, max_virtual_nodes=2, n_res=3)
+    spec = [uniform_cluster(1, 1, cores=2, memory=500)]
+    vslot = cfg.max_nodes  # the traded slot's index
+    state = init_state(cfg, spec, fault_events=[(0, vslot, 5_000, 9_000)])
+    state, ok = host_ops.add_virtual_node(state, 8, 4_000, 60_000,
+                                          vstart=cfg.max_nodes)
+    assert bool(ok)
+    arr = uniform_stream(1, 1, 1_000, max_cores=4, max_mem=2_000,
+                         max_dur_ms=50_000, seed=1)
+    arr = arr.replace(cores=jnp.full_like(arr.cores, 4),
+                      mem=jnp.full_like(arr.mem, 2_000),
+                      dur=jnp.full_like(arr.dur, 50_000))
+    ta = pack_arrivals_by_tick(arr, 20, TICK)
+    eng = Engine(cfg)
+    fn = eng.run_jit()
+    mid = fn(state, ta, 6)  # past the fail tick
+    assert int(np.asarray(mid.faults.kills)[0]) == 1
+    assert not bool(np.asarray(mid.faults.health)[0, vslot])
+    assert not bool(np.asarray(mid.node_active)[0, vslot])
+    # a new trade must NOT reclaim the down slot — it lands on the OTHER
+    # virtual slot
+    mid2, ok2 = host_ops.add_virtual_node(mid, 1, 100, 1_000,
+                                          vstart=cfg.max_nodes)
+    assert bool(ok2)
+    assert not bool(np.asarray(mid2.node_active)[0, vslot])
+    assert bool(np.asarray(mid2.node_active)[0, vslot + 1])
+    # run past the repair: the vnode comes back with full (empty) capacity
+    out = fn(mid, ta, 14)
+    assert bool(np.asarray(out.faults.health)[0, vslot])
+    assert bool(np.asarray(out.node_active)[0, vslot])
+    cap = np.asarray(out.node_cap)[0, vslot]
+    run_there = (np.asarray(out.run.node)[0] == vslot) \
+        & np.asarray(out.run.active)[0]
+    used = np.zeros(3, np.int64)
+    for s in np.flatnonzero(run_there):
+        used += [np.asarray(out.run.cores)[0, s],
+                 np.asarray(out.run.mem)[0, s],
+                 np.asarray(out.run.gpu)[0, s]]
+    assert (np.asarray(out.node_free)[0, vslot] == cap - used).all()
+    check_conservation(out)
+
+
+# ---------------------------------------------------------------------------
+# environment mode: per-env churn
+# ---------------------------------------------------------------------------
+
+def test_env_generative_faults_diverge_per_env_and_survive_reset():
+    from multi_cluster_simulator_tpu.envs import ClusterEnv, StreamGen
+    from multi_cluster_simulator_tpu.faults.schedule import initial_next_fail
+
+    fc = dataclasses.replace(_CHURN, mttf_ms=5_000, mttr_ms=1_000)
+    cfg = _cfg(2, faults=fc, queue_capacity=16, max_running=32,
+               max_arrivals=8, max_ingest_per_tick=8)
+    env = ClusterEnv(cfg, _specs(2), episode_ticks=10,
+                     gen=StreamGen(rate=1.0, k_max=4))
+    obs, es = env.reset_batch(jax.random.PRNGKey(7), 2)
+    # independent churn streams per env
+    assert not np.array_equal(np.asarray(es.sim.faults.key[0]),
+                              np.asarray(es.sim.faults.key[1]))
+    step = env.batch_step_fn(donate=False)
+    for _ in range(25):  # crosses two auto-reset boundaries
+        obs, r, d, info, es = step(es, None)
+    assert (np.asarray(es.episodes) == 2).all()
+    # churn engaged somewhere in the batch
+    assert int(np.asarray(es.sim.faults.n_fails).sum()) > 0
+    # per-env keys survived auto-reset, and the post-reset failure clocks
+    # are the key's own episode-0 draws (not the base config stream's)
+    for e in range(2):
+        keys_e = jnp.asarray(np.asarray(es.sim.faults.key[e]))  # [C, 2]
+        want = np.asarray(jax.vmap(
+            lambda kk: initial_next_fail(kk, cfg.total_nodes,
+                                         cfg.faults))(keys_e))  # [C, N]
+        # env e is 5 ticks into its third episode; nodes that have not
+        # failed yet still carry their OWN key's episode-0 draw (never
+        # the base config stream's) where it lies beyond the clock
+        t_e = int(np.asarray(es.sim.t)[e])
+        nf = np.asarray(es.sim.faults.n_fails[e])
+        still = (nf == 0) & np.asarray(es.sim.faults.health[e])
+        mask = still & (want > t_e)
+        got = np.asarray(es.sim.faults.next_fail[e])
+        assert mask.any()
+        assert np.array_equal(got[mask], want[mask])
+
+
+# ---------------------------------------------------------------------------
+# serving WAL + checkpoint recovery
+# ---------------------------------------------------------------------------
+
+def _serving(tmp_path, name, wal=True, ckpt=True, **kw):
+    from multi_cluster_simulator_tpu.services.serving import ServingScheduler
+    cfg = SimConfig(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                    queue_capacity=64, max_running=128, max_arrivals=32,
+                    max_ingest_per_tick=16, max_nodes=5,
+                    max_virtual_nodes=0)
+    specs = _specs(2)
+    kw.setdefault("pacer", False)
+    return ServingScheduler(
+        name, specs, cfg, window=4, warm_k=(4,), k_cap=32,
+        max_staged=10 ** 6,
+        wal_path=str(tmp_path / "serve.wal") if wal else None,
+        checkpoint_path=str(tmp_path / "serve.ckpt") if ckpt else None,
+        checkpoint_every=2, **kw)
+
+
+def _feed(s, jobs_per_tick, ticks, jid0=1, dispatch_every=None):
+    jid = jid0
+    for t in range(ticks):
+        for k in range(jobs_per_tick):
+            assert s.submit_direct(c=(jid % 2), jid=jid, cores=1 + jid % 3,
+                                   mem=100 + 10 * (jid % 7), dur_ms=2_000)
+            jid += 1
+        s.seal_tick()
+        if dispatch_every and (t + 1) % dispatch_every == 0:
+            s.dispatch_sealed()
+    return jid
+
+
+def test_wal_crash_between_ack_and_dispatch_recovers(tmp_path):
+    """The exact hole the WAL closes: jobs 200-acked (staged + fsync'd)
+    but never dispatched are lost by a kill -9 without a WAL; with one,
+    the restarted service replays them and the final state is
+    bit-identical to an uninterrupted run over the same stream."""
+    s1 = _serving(tmp_path, "serve-wal-1")
+    jid = _feed(s1, 3, 8, dispatch_every=4)  # first window dispatched...
+    _feed(s1, 3, 4, jid0=jid)  # ...these 4 ticks acked, NEVER dispatched
+    # kill -9: no shutdown, no flush — abandon the object entirely
+    ticks_done = s1.ticks_dispatched
+    assert ticks_done == 8
+
+    s2 = _serving(tmp_path, "serve-wal-2")
+    assert s2.recovered_jobs == 3 * 4
+    assert s2.ticks_dispatched == ticks_done
+    s2.dispatch_sealed()
+    while s2._staged_ticks() < 20:  # drain tail: everything completes
+        s2.seal_tick()
+    s2.dispatch_sealed()
+    state_rec = s2.state_host()
+
+    # uninterrupted reference over the same effective stream
+    ref = _serving(tmp_path / "ref", "serve-wal-ref", wal=False, ckpt=False)
+    _feed(ref, 3, 12)
+    while ref._staged_ticks() < 20:
+        ref.seal_tick()
+    ref.dispatch_sealed()
+    state_ref = ref.state_host()
+    assert _tree_equal(state_rec, state_ref), \
+        "recovered state diverged from the uninterrupted run"
+    assert state_rec.t == 20 * TICK
+    drops = total_drops(state_rec)
+    assert all(v == 0 for v in drops.values()), drops
+    assert int(np.asarray(state_rec.placed_total).sum()) == 3 * 12
+
+
+def test_wal_torn_final_record_discarded(tmp_path):
+    from multi_cluster_simulator_tpu.services import wal as walmod
+
+    s1 = _serving(tmp_path, "serve-torn-1", ckpt=False)
+    _feed(s1, 2, 3)
+    path = str(tmp_path / "serve.wal")
+    records, _offs, off, torn = walmod.read_records(path)
+    assert len(records) == 6 and not torn
+    with open(path, "ab") as f:  # a crash mid-append: half a record
+        f.write(b"\x40\x00\x00\x00\x12\x34\x56\x78corrupt")
+    records2, _offs2, off2, torn2 = walmod.read_records(path)
+    assert torn2 and len(records2) == 6 and off2 == off
+    # recovery truncates the tail; fresh appends stay readable
+    s2 = _serving(tmp_path, "serve-torn-2", ckpt=False)
+    assert s2.wal_torn_tail and s2.recovered_jobs == 6
+    assert s2.submit_direct(c=0, jid=999, cores=1, mem=100, dur_ms=1_000)
+    records3, _offs3, _o3, torn3 = walmod.read_records(path)
+    assert not torn3 and len(records3) == 7
+    assert records3[-1]["i"] == 999
+
+
+def test_wal_double_replay_idempotent(tmp_path):
+    """Recovery is a pure function of (checkpoint, WAL): recovering twice
+    from the same file pair — the crash-during-recovery shape — yields
+    the same state and never duplicates a job."""
+    import shutil
+
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    s1 = _serving(tmp_path, "serve-dup-1")
+    _feed(s1, 2, 6, dispatch_every=2)
+    _feed(s1, 2, 2, jid0=1000)  # acked, undispatched
+    for d in ("a", "b"):  # identical crash images for both recoveries
+        shutil.copy(tmp_path / "serve.wal", tmp_path / d / "serve.wal")
+        shutil.copy(tmp_path / "serve.ckpt", tmp_path / d / "serve.ckpt")
+
+    def recover_and_finish(d, name):
+        s = _serving(tmp_path / d, name)
+        # the last checkpoint landed at dispatch 2 (ticks 0-3), so replay
+        # covers the checkpoint-lag window (ticks 4-5, dispatched after
+        # it) AND the never-dispatched ticks 6-7 — 8 jobs, exactly once
+        # each relative to the restored watermark
+        assert s.recovered_jobs == 8
+        s.dispatch_sealed()
+        while s._staged_ticks() < 12:
+            s.seal_tick()
+        s.dispatch_sealed()
+        return s.state_host()
+
+    a = recover_and_finish("a", "serve-dup-2")
+    b = recover_and_finish("b", "serve-dup-3")
+    assert _tree_equal(a, b)
+    assert int(np.asarray(a.placed_total).sum()) == 2 * 8  # no duplicates
+
+
+def test_wal_rotation_bounds_growth_and_recovery_seeks(tmp_path):
+    """The WAL does not grow without bound: once the dispatched prefix
+    exceeds wal_rotate_bytes, the checkpoint cadence compacts the log to
+    the live suffix (a fresh generation), recovery seeks to the stored
+    offset instead of decoding history — and none of it changes the
+    recovered state."""
+    import os
+
+    from multi_cluster_simulator_tpu.services import wal as walmod
+
+    path = str(tmp_path / "serve.wal")
+    s1 = _serving(tmp_path, "serve-rot-1", wal_rotate_bytes=1)  # always
+    gen0 = s1._wal.generation
+    jid = _feed(s1, 2, 8, dispatch_every=2)  # rotations at checkpoints
+    assert s1._wal.generation != gen0, "rotation never fired"
+    _feed(s1, 2, 2, jid0=jid)  # acked, undispatched — the live suffix
+    size = os.path.getsize(path)
+    # the file holds ~the live suffix, not the 16-job history: well under
+    # half the bytes 20 records would occupy
+    records, _offs, _off, _torn = walmod.read_records(path)
+    assert len(records) <= 8  # checkpoint-lag window + undispatched only
+    assert size < 8 * 120
+
+    s2 = _serving(tmp_path, "serve-rot-2", wal_rotate_bytes=1)
+    s2.dispatch_sealed()
+    while s2._staged_ticks() < 16:
+        s2.seal_tick()
+    s2.dispatch_sealed()
+    state_rec = s2.state_host()
+
+    ref = _serving(tmp_path / "ref", "serve-rot-ref", wal=False, ckpt=False)
+    _feed(ref, 2, 10)
+    while ref._staged_ticks() < 16:
+        ref.seal_tick()
+    ref.dispatch_sealed()
+    assert _tree_equal(state_rec, ref.state_host()), \
+        "rotation/seek recovery diverged from the uninterrupted run"
+    assert int(np.asarray(state_rec.placed_total).sum()) == 2 * 10
+
+
+def test_wal_recovery_without_checkpoint(tmp_path):
+    """Killed before the first checkpoint: recovery replays the WHOLE WAL
+    from a fresh state."""
+    s1 = _serving(tmp_path, "serve-nockpt-1", ckpt=False)
+    _feed(s1, 2, 5)  # nothing ever dispatched, no checkpoint file
+    s2 = _serving(tmp_path, "serve-nockpt-2", ckpt=False)
+    assert s2.recovered_jobs == 10
+    s2.dispatch_sealed()
+    while s2._staged_ticks() < 12:
+        s2.seal_tick()
+    s2.dispatch_sealed()
+    assert int(np.asarray(s2.state_host().placed_total).sum()) == 10
+
+
+# ---------------------------------------------------------------------------
+# wedged-shutdown honesty
+# ---------------------------------------------------------------------------
+
+def test_serving_wedged_stop_flips_healthz(tmp_path):
+    import threading
+
+    from multi_cluster_simulator_tpu.services import httpd
+
+    s = _serving(tmp_path, "serve-wedge", wal=False, ckpt=False, pacer=True)
+    wedge = threading.Event()
+    s._drive_loop = lambda: wedge.wait()  # injected wedge: ignores _stop
+    s.stop_join_timeout_s = 0.2
+    s.pacer_join_timeout_s = 0.2
+    s.start()
+    try:
+        code, _ = httpd.get(s.url + "/healthz")
+        assert code == 200
+        s.shutdown()
+        # the wedge is honest: shutdown did NOT pretend to succeed — the
+        # diagnostic surface stays up and /healthz answers 503 naming it
+        code, body = httpd.get(s.url + "/healthz")
+        assert code == 503
+        import json
+        d = json.loads(body)
+        assert d["shutdown_wedged"] is False
+        assert "drive" in d["wedged_thread"]
+    finally:
+        wedge.set()
+        s._wedged = None
+        s.httpd.shutdown()
+
+
+def test_scheduler_host_wedged_stop_flips_healthz():
+    import threading
+
+    from multi_cluster_simulator_tpu.services import httpd
+    from multi_cluster_simulator_tpu.services.scheduler_host import (
+        SchedulerService,
+    )
+
+    cfg = SimConfig(policy=PolicyKind.DELAY, queue_capacity=16,
+                    max_running=16, max_arrivals=16, max_nodes=2, n_res=3)
+    s = SchedulerService("sched-wedge", uniform_cluster(1, 2), cfg,
+                         speed=1000.0, grpc_port=None)
+    wedge = threading.Event()
+    s._tick_loop = lambda: wedge.wait()
+    s.stop_join_timeout_s = 0.2
+    s.start()
+    try:
+        s.shutdown()
+        code, body = httpd.get(s.url + "/healthz")
+        assert code == 503
+        import json
+        d = json.loads(body)
+        assert d["shutdown_wedged"] is False
+        assert "tick" in d["wedged_thread"]
+    finally:
+        wedge.set()
+        s._wedged = None
+        s.httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# retry/breaker primitives
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_state_machine():
+    from multi_cluster_simulator_tpu.services.backoff import CircuitBreaker
+
+    now = [0.0]
+    br = CircuitBreaker(fail_threshold=3, reset_after_s=10.0,
+                        clock=lambda: now[0])
+    assert br.state == br.CLOSED and br.allow()
+    br.record_failure(), br.record_failure()
+    assert br.state == br.CLOSED and br.allow()  # under the threshold
+    br.record_failure()
+    assert br.state == br.OPEN and not br.allow()  # opened
+    now[0] = 9.9
+    assert not br.allow()
+    now[0] = 10.1
+    assert br.allow()  # the half-open probe
+    assert not br.allow()  # only ONE probe admitted
+    br.record_failure()  # probe failed -> re-open immediately
+    assert br.state == br.OPEN and not br.allow()
+    now[0] = 25.0
+    assert br.allow()
+    br.record_success()  # probe succeeded -> closed, counters reset
+    assert br.state == br.CLOSED and br.allow()
+    assert br.opened_total == 2
+
+
+def test_jittered_backoff_bounds():
+    from multi_cluster_simulator_tpu.services.backoff import (
+        jittered_backoff_ms,
+    )
+
+    rng = np.random.default_rng(1)
+    for attempt in range(8):
+        for _ in range(20):
+            d = jittered_backoff_ms(attempt, 100.0, 2_000.0, rng)
+            lo = min(2_000.0, 100.0 * 2 ** attempt) / 2
+            hi = min(2_000.0, 100.0 * 2 ** attempt)
+            assert lo <= d <= hi
+
+
+def test_trader_breaker_skips_dead_peer_quickly():
+    """Integration: a trader whose only peer is a black hole opens the
+    breaker after the failure threshold, and later rounds skip the peer
+    without dialing (no collect-window stall)."""
+    from multi_cluster_simulator_tpu.services.backoff import CircuitBreaker
+    from multi_cluster_simulator_tpu.services.trader_host import TraderService
+
+    tr = TraderService.__new__(TraderService)  # no sockets: unit-wire it
+    import threading
+
+    from multi_cluster_simulator_tpu.config import TraderConfig
+    tr.tcfg = TraderConfig()
+    tr.speed = 1000.0
+    tr._peer_lock = threading.Lock()
+    tr._breakers = {}
+    tr.rpc_attempts = 2
+    tr.rpc_backoff_base_ms = 0.1
+    tr.breaker_fail_threshold = 3
+    tr._stop = threading.Event()
+
+    class _Meter:
+        def __init__(self):
+            self.counts = {}
+
+        def add(self, k, v):
+            self.counts[k] = self.counts.get(k, 0) + v
+
+        def set_gauge(self, k, v):
+            self.counts[k] = v
+
+    tr.meter = _Meter()
+    calls = {"n": 0}
+
+    def dead_rpc():
+        calls["n"] += 1
+        raise ConnectionError("black hole")
+
+    url = "dns:///dead:1"
+    # enough rounds to open the breaker (2 attempts per call)
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            tr._rpc_call(url, dead_rpc)
+    assert tr._breaker(url).state == CircuitBreaker.OPEN
+    dialed = calls["n"]
+    assert not tr._breaker(url).allow()  # skipped: no dial at all
+    assert calls["n"] == dialed
+    ok, detail = tr.health()
+    assert ok and detail["peer_breakers"][url] == CircuitBreaker.OPEN
+    assert tr.meter.counts["peer_rpc_failures"] == dialed

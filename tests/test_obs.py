@@ -141,15 +141,18 @@ def test_obs_sharded_mesh_matches_single_device():
 
 
 def test_run_prefix_full_equals_run():
-    """The profile plane's phase-prefix ablation hook: phase_limit=7 is
-    the whole tick, so its scan must equal ``run`` bit for bit (guards
-    the phase-gating refactor of the tick body)."""
+    """The profile plane's phase-prefix ablation hook: phase_limit at the
+    full TICK_PHASES count is the whole tick, so its scan must equal
+    ``run`` bit for bit (guards the phase-gating refactor of the tick
+    body)."""
+    from multi_cluster_simulator_tpu.obs.profile import TICK_PHASES
+
     cfg, arr, specs = _cfg(), _bursty_arrivals(), _specs(3)
     ta = pack_arrivals_by_tick(arr, N_TICKS, TICK_MS)
     eng = Engine(cfg)
     ref = eng.run_jit()(init_state(cfg, specs), ta, N_TICKS)
     out = jax.jit(eng.run_prefix, static_argnums=(2, 3))(
-        init_state(cfg, specs), ta, N_TICKS, 7)
+        init_state(cfg, specs), ta, N_TICKS, len(TICK_PHASES))
     _assert_trees_equal(ref, out)
 
 

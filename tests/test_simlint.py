@@ -527,7 +527,7 @@ def test_lockset_parses_telemetry_and_trader_annotations():
     assert "_counters" in tel["Meter"].guards["_lock"]
     tr = parse_locks(_module("services/trader_host.py"))
     assert set(tr["TraderService"].guards["_peer_lock"]) == {
-        "_peer_clients", "trades_won", "trades_sold"}
+        "_peer_clients", "_breakers", "trades_won", "trades_sold"}
 
 
 def test_purity_reaches_the_tick_internals():

@@ -1,0 +1,410 @@
+#!/usr/bin/env python
+"""Chaos harness for the serving tier's crash-recovery contract.
+
+Kill -9s a live ``ServingScheduler`` child at randomized points under real
+HTTP traffic, restarts it (restore checkpoint + replay WAL suffix —
+services/serving.py ``_recover``), and after >= ``--cycles`` crash/restart
+rounds asserts the durability story the 200-ack promises:
+
+1. **zero acked-job loss** — every job a client got a 200 for is in the
+   fsync'd WAL (the ack ordering guarantees it) and every WAL job is
+   eventually PLACED by the recovered server (final placed_total equals
+   the WAL job count; the drain loop runs the server until its queues and
+   running set are empty);
+2. **bit-identical recovery** — the recovered server's final device state
+   equals an UNINTERRUPTED in-process reference run over the same
+   effective stream (the WAL, replayed tick-faithfully, sealed to the
+   same total tick count): crashes are invisible to the simulation;
+3. **no silent drops** — every drop counter stays zero on both sides
+   (client duplicates from lost acks are legal — they are distinct WAL
+   records and both copies place — and are counted in the report).
+
+Clients treat a dead server as retryable: connection failures back off
+(jittered exponential, services/backoff.py) and re-read the child's URL
+file, so traffic keeps flowing across restarts; 503 quotes honor
+``RetryAfterMs`` under a bounded budget.
+
+Usage:
+  python tools/chaos.py [--quick] [--cycles N] [--jobs N] [--out PATH]
+  python tools/chaos.py --serve --dir D --url-file F   (child mode)
+
+CI runs ``--quick`` (2 cycles); the full run is >= 5 cycles (the
+acceptance bar). Everything is pinned to host CPU — the deployment shape
+measured is an engine colocated with its host (the bench `serving`
+pattern).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPEED = 100.0
+WINDOW = 4
+N_CLUSTERS = 4
+
+
+def chaos_cfg():
+    """The one config both the child server and the in-process reference
+    build — the bit-identity gate depends on them agreeing."""
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    return SimConfig(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                     queue_capacity=256, max_running=512, max_arrivals=64,
+                     max_ingest_per_tick=16, max_nodes=10,
+                     max_virtual_nodes=0)
+
+
+def chaos_specs():
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    return [uniform_cluster(c + 1, 10) for c in range(N_CLUSTERS)]
+
+
+def serve(dirpath: str, url_file: str) -> None:
+    """Child mode: host the serving tier with WAL + checkpoints armed and
+    publish the URL, then sleep until killed (the whole point: the parent
+    kills -9, never politely)."""
+    from multi_cluster_simulator_tpu.services.serving import ServingScheduler
+
+    s = ServingScheduler(
+        "chaos-serve", chaos_specs(), chaos_cfg(), speed=SPEED,
+        window=WINDOW, pacer=True, warm_k=(16, 64), k_cap=64,
+        max_staged=10 ** 6,
+        wal_path=os.path.join(dirpath, "serve.wal"),
+        checkpoint_path=os.path.join(dirpath, "serve.ckpt"),
+        checkpoint_every=4)
+    s.start()
+    tmp = url_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(s.url)
+    os.replace(tmp, url_file)
+    while True:  # until SIGKILL
+        time.sleep(0.5)
+
+
+class _Client(threading.Thread):
+    """One traffic generator: /submitBatch with retry discipline across
+    503 back-pressure AND dead-server windows. Records every job id the
+    server ACKED (a 200, or the accepted complement of a 503's
+    RejectedIdx) — the zero-loss gate's ground truth.
+
+    Paced (a jittered gap between batches) and duration-driven: it keeps
+    submitting until the parent's ``traffic_done`` event (set only AFTER
+    the last kill/restart cycle) or the job cap — so every kill lands
+    under genuinely live traffic, which the parent asserts."""
+
+    def __init__(self, ci, n_jobs, batch, url_file, stop_flag,
+                 traffic_done):
+        super().__init__(daemon=True, name=f"chaos-client-{ci}")
+        import numpy as np
+        self.ci = ci
+        self.n_jobs = n_jobs
+        self.batch = batch
+        self.url_file = url_file
+        self.stop_flag = stop_flag
+        self.traffic_done = traffic_done
+        self.rng = np.random.default_rng(4000 + ci)
+        self.acked: list[tuple[int, int]] = []  # (cluster, id)
+        self.conn_retries = 0
+        self.retries_503 = 0
+        self.error = None
+
+    def _url(self):
+        try:
+            with open(self.url_file) as f:
+                return f.read().strip()
+        except OSError:
+            return None
+
+    def run(self):
+        try:
+            self._run()
+        except Exception as e:  # surfaced by the parent's join
+            self.error = e
+
+    def _run(self):
+        from multi_cluster_simulator_tpu.services import httpd
+        from multi_cluster_simulator_tpu.services.backoff import (
+            jittered_backoff_ms,
+        )
+        from multi_cluster_simulator_tpu.services.scheduler_host import (
+            job_to_json,
+        )
+        sent = 0
+        jid = self.ci * 10_000_000
+        while (sent < self.n_jobs and not self.stop_flag.is_set()
+               and not self.traffic_done.is_set()):
+            time.sleep(float(self.rng.uniform(0.02, 0.08)))  # pacing
+            rows = []
+            meta = []
+            for _ in range(min(self.batch, self.n_jobs - sent)):
+                jid += 1
+                c = int(self.rng.integers(0, N_CLUSTERS))
+                rows.append({**job_to_json(
+                    jid, int(self.rng.integers(1, 4)),
+                    int(self.rng.integers(100, 2000)),
+                    int(self.rng.integers(500, 2001))), "Cluster": c})
+                meta.append((c, jid))
+            sent += len(rows)
+            attempt = 0
+            while rows:
+                if self.stop_flag.is_set():
+                    return
+                url = self._url()
+                code, body = (0, b"") if url is None else httpd.post_json(
+                    url + "/submitBatch", rows, timeout=5.0)
+                if code == 200:
+                    self.acked.extend(meta)
+                    break
+                if code == 503:
+                    e = json.loads(body)
+                    rej = set(e["RejectedIdx"])
+                    self.acked.extend(m for k, m in enumerate(meta)
+                                      if k not in rej)
+                    rows = [rows[k] for k in sorted(rej)]
+                    meta = [meta[k] for k in sorted(rej)]
+                    self.retries_503 += 1
+                    base = max(float(e.get("RetryAfterMs", 20.0)), 5.0)
+                else:
+                    # dead / restarting server: NOTHING acked this round
+                    # (a lost ack after a successful stage just means a
+                    # duplicate WAL record on retry — legal)
+                    self.conn_retries += 1
+                    base = 50.0
+                attempt += 1
+                if attempt > 400:
+                    raise AssertionError(
+                        f"client {self.ci}: retry budget exhausted "
+                        f"({len(rows)} jobs undelivered)")
+                time.sleep(jittered_backoff_ms(
+                    min(attempt, 6), base, 2_000.0, self.rng) / 1000.0)
+
+
+def run_chaos(cycles: int, jobs: int, out: str | None, workdir: str | None,
+              keep: bool = False) -> dict:
+    import numpy as np
+
+    from multi_cluster_simulator_tpu.services import httpd, wal as walmod
+
+    dirpath = workdir or tempfile.mkdtemp(prefix="mcs-chaos-")
+    url_file = os.path.join(dirpath, "serve.url")
+    wal_path = os.path.join(dirpath, "serve.wal")
+    ckpt_path = os.path.join(dirpath, "serve.ckpt")
+    rng = np.random.default_rng(99)
+    child = {"proc": None}
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    for k in list(env):
+        if k.startswith(("TPU_", "LIBTPU")) or k == "PJRT_DEVICE":
+            env.pop(k)
+
+    def spawn():
+        if os.path.exists(url_file):
+            os.remove(url_file)
+        child["proc"] = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--serve",
+             "--dir", dirpath, "--url-file", url_file],
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if child["proc"].poll() is not None:
+                err = child["proc"].stderr.read().decode()[-4000:]
+                raise RuntimeError(f"chaos child died at startup:\n{err}")
+            if os.path.exists(url_file):
+                with open(url_file) as f:
+                    url = f.read().strip()
+                code, _ = httpd.get(url + "/healthz", timeout=2.0)
+                if code == 200:
+                    return url
+            time.sleep(0.05)
+        raise RuntimeError("chaos child never became healthy")
+
+    def stats(url):
+        code, body = httpd.get(url + "/stats", timeout=5.0)
+        return json.loads(body) if code == 200 else None
+
+    t_start = time.time()
+    url = spawn()
+    stop_flag = threading.Event()
+    traffic_done = threading.Event()
+    clients = [_Client(ci, jobs // 2, 32, url_file, stop_flag, traffic_done)
+               for ci in range(2)]
+    for c in clients:
+        c.start()
+
+    kills = 0
+    live_kills = 0
+    try:
+        # ---- the chaos loop: kill -9 mid-traffic, restart, repeat.
+        # traffic_done is only set AFTER the last cycle, so every kill
+        # lands under live traffic (asserted below) ----
+        for cycle in range(cycles):
+            time.sleep(float(rng.uniform(0.5, 1.5)))
+            live_kills += int(any(c.is_alive() for c in clients))
+            child["proc"].send_signal(signal.SIGKILL)
+            child["proc"].wait()
+            kills += 1
+            time.sleep(float(rng.uniform(0.05, 0.3)))  # clients see it die
+            url = spawn()
+        time.sleep(0.5)  # a last live window against the final incarnation
+        traffic_done.set()
+        assert live_kills == kills, (
+            f"only {live_kills}/{kills} kills landed under live traffic — "
+            "the clients drained early; raise --jobs or the pacing")
+        # ---- traffic completes against the final incarnation ----
+        deadline = time.time() + 600
+        for c in clients:
+            c.join(timeout=max(deadline - time.time(), 1))
+            if c.is_alive():
+                raise RuntimeError(f"client {c.ci} never finished")
+            if c.error is not None:
+                raise c.error
+        # ---- drain: the pacer keeps sealing empty ticks; wait until the
+        # constellation is empty and placement has converged ----
+        while time.time() < deadline:
+            st = stats(url)
+            if (st is not None and st["staged_jobs"] == 0
+                    and st["queue_depth"] == 0 and st["running"] == 0):
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError(f"drain never converged: {stats(url)}")
+        code, body = httpd.post_json(url + "/admin/quiesce", {},
+                                     timeout=120.0)
+        assert code == 200, f"quiesce -> {code}: {body!r}"
+        q = json.loads(body)
+    finally:
+        stop_flag.set()
+        if child["proc"] is not None and child["proc"].poll() is None:
+            child["proc"].send_signal(signal.SIGKILL)
+            child["proc"].wait()
+
+    # ---- verification ----
+    records, _offs, _off, torn = walmod.read_records(wal_path)
+    acked = {m for c in clients for m in c.acked}
+    wal_ids = {(r["c"], r["i"]) for r in records}
+    missing = acked - wal_ids
+    assert not missing, (
+        f"ACKED JOBS LOST: {len(missing)} jobs were 200-acked but never "
+        f"reached the WAL (first: {sorted(missing)[:5]}) — the fsync-"
+        "before-ack contract is broken")
+    assert q["placed"] == len(records), (
+        f"placed_total {q['placed']} != WAL job count {len(records)} — "
+        "acked work was lost or duplicated inside the engine")
+
+    # uninterrupted reference over the same effective stream: replay the
+    # WAL tick-faithfully into a fresh in-process server, seal to the
+    # crashed run's exact tick count, dispatch everything
+    from multi_cluster_simulator_tpu.core.checkpoint import load_state
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.services.serving import ServingScheduler
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+
+    cfg = chaos_cfg()
+    ref = ServingScheduler("chaos-ref", chaos_specs(), cfg, pacer=False,
+                           window=WINDOW, warm_k=(16, 64), k_cap=64,
+                           max_staged=10 ** 6)
+    tick = cfg.tick_ms
+    for rec in records:
+        dest = max((int(rec["t"]) + tick - 1) // tick, 1) - 1
+        while ref._staged_ticks() < dest:
+            ref.seal_tick()
+        ok = ref.submit_direct(int(rec["c"]), int(rec["i"]), int(rec["co"]),
+                               int(rec["m"]), int(rec["du"]),
+                               gpu=int(rec["g"]), delay=bool(rec["dl"]),
+                               ta=int(rec["t"]))
+        assert ok, f"reference replay rejected job {rec['i']}"
+    while ref._staged_ticks() < q["ticks_dispatched"]:
+        ref.seal_tick()
+    ref.dispatch_sealed()
+    ref_state = ref.state_host()
+    rec_state = load_state(ckpt_path, init_state(cfg, chaos_specs()))
+
+    import jax
+    diverged = []
+    ref_leaves = jax.tree_util.tree_leaves_with_path(ref_state)
+    rec_leaves = jax.tree_util.tree_leaves_with_path(
+        jax.tree.map(np.asarray, rec_state))
+    for (pa, la), (_pb, lb) in zip(ref_leaves, rec_leaves):
+        if not np.array_equal(np.asarray(la), np.asarray(lb)):
+            diverged.append(jax.tree_util.keystr(pa))
+    assert not diverged, (
+        f"RECOVERED STATE DIVERGED from the uninterrupted reference on "
+        f"{len(diverged)} leaves: {diverged[:6]} — crash recovery is not "
+        "replay-invisible")
+    for label, state in (("reference", ref_state), ("recovered", rec_state)):
+        drops = total_drops(state)
+        assert all(v == 0 for v in drops.values()), (
+            f"{label} state dropped work: {drops}")
+
+    dup = len(records) - len(wal_ids)
+    report = {
+        "cycles": kills,
+        "kills_under_live_traffic": live_kills,
+        "jobs_acked": len(acked),
+        "wal_records": len(records),
+        "duplicate_resubmits": dup,
+        "wal_torn_tail_seen": torn,
+        "placed_total": q["placed"],
+        "ticks_dispatched": q["ticks_dispatched"],
+        "recovered_jobs_last_restart": q.get("recovered_jobs", 0),
+        "client_conn_retries": sum(c.conn_retries for c in clients),
+        "client_retries_503": sum(c.retries_503 for c in clients),
+        "acked_jobs_lost": 0,
+        "final_state_bit_identical": True,
+        "wall_s": round(time.time() - t_start, 1),
+        "workdir": dirpath if keep else None,
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+    if not keep and workdir is None:
+        import shutil
+        shutil.rmtree(dirpath, ignore_errors=True)
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke: 2 kill/restart cycles, less traffic")
+    ap.add_argument("--cycles", type=int, default=None,
+                    help="kill -9/restart cycles (default 5; the "
+                         "acceptance bar)")
+    ap.add_argument("--jobs", type=int, default=None)
+    ap.add_argument("--out", default=None, help="write the JSON report here")
+    ap.add_argument("--dir", default=None, help="workdir (kept if given)")
+    ap.add_argument("--serve", action="store_true", help="child mode")
+    ap.add_argument("--url-file", default=None)
+    args = ap.parse_args()
+
+    if args.serve:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        serve(args.dir, args.url_file)
+        return
+
+    cycles = args.cycles or (2 if args.quick else 5)
+    # a CAP, not a target: clients are duration-driven (they outlast the
+    # chaos loop) and paced, so the cap only guards a runaway
+    jobs = args.jobs or (20_000 if args.quick else 60_000)
+    report = run_chaos(cycles, jobs, args.out, args.dir,
+                       keep=args.dir is not None)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
