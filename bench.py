@@ -40,6 +40,10 @@ import numpy as np
 # The reference cannot checkpoint at all (SURVEY.md §5); here a run killed
 # at any chunk boundary resumes bit-exactly (core/checkpoint.py).
 _CKPT = {"path": None, "resume": False}
+# checkpoint-overhead A/B cutoff: rows whose timed wall exceeds this skip
+# the extra saves-on measurement run (a multi-hour record row must not pay
+# a third full pass for a number the churn_bursts row already records)
+_CKPT_AB_MAX_WALL_S = 600.0
 
 # Streamed-arrival-pipeline knobs, set by main() from --pipeline /
 # --stream-arrivals. mode "off" is the pre-pipeline path (stream-global K,
@@ -159,7 +163,8 @@ def _peak_hbm_bytes():
 # TPU pays ~0.5 s per dispatch). One marker list + one env builder so a
 # new child-mode config inherits the whole discipline — the axon
 # sitecustomize guard in _setup_jax included — instead of re-copying it.
-_CHILD_MARKERS = ("MCS_LIVE_CHILD", "MCS_SERVING_CHILD", "MCS_FAULTS_CHILD")
+_CHILD_MARKERS = ("MCS_LIVE_CHILD", "MCS_SERVING_CHILD", "MCS_FAULTS_CHILD",
+                  "MCS_CHAOS_CHILD")
 
 
 def _is_bench_child() -> bool:
@@ -186,7 +191,8 @@ def _cpu_child_env(marker: str, n_devices=None) -> dict:
 
 
 def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
-                repeats=3, warmups=0, tick_indexed=False, mesh_devices=None):
+                repeats=3, warmups=0, tick_indexed=False, mesh_devices=None,
+                fault_events=None):
     """Advance n_ticks in jitted chunks (one device call per chunk — a single
     multi-minute executable can trip device RPC deadlines).
 
@@ -215,7 +221,7 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     import jax
     import jax.numpy as jnp
 
-    from multi_cluster_simulator_tpu.core.checkpoint import load_state, save_state
+    from multi_cluster_simulator_tpu.core import preempt
     from multi_cluster_simulator_tpu.core.compact import (
         derive_plan, state_nbytes,
     )
@@ -226,15 +232,23 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
 
     plan = (derive_plan(cfg, specs, arrivals)
             if _COMPACT["mode"] == "on" else None)
-    state = init_state(cfg, specs, plan=plan)
+    state = init_state(cfg, specs, plan=plan, fault_events=fault_events)
     ckpt = _CKPT["path"]
+    # the checkpoint header's validity record: a resume under a different
+    # config, storage plan, or policy params must fail fast with a named
+    # field (core/checkpoint.py v2), never silently corrupt a long run
+    pdigest = preempt.policy_digest_for(cfg) if ckpt else None
     info = {"ran_ticks": n_ticks, "placed_before_resume": 0,
             "state_bytes": state_nbytes(state),
             "compact": ({"plan": plan.describe()} if plan is not None
                         else {"mode": "off"})}
     off0 = 0
+    prior_meta = {}  # resume cursors from the loaded RunCheckpoint
+    mbuf_resumed = None
     if ckpt and _CKPT["resume"] and os.path.exists(ckpt):
-        state = load_state(ckpt, state)
+        rc = preempt.load_run(ckpt, state, cfg=cfg, plan=plan,
+                              policy_digest=pdigest)
+        state, mbuf_resumed, prior_meta = rc.state, rc.mbuf, rc.meta
         done = int(np.asarray(state.t)) // cfg.tick_ms
         print(f"# resumed from {ckpt} at tick {done}", file=sys.stderr)
         off0 = done
@@ -322,7 +336,10 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     from multi_cluster_simulator_tpu.obs import device as obs_dev
     from multi_cluster_simulator_tpu.obs.profile import annotate_dispatch
     obs_on = _OBS["mode"] in ("on", "ab")
-    mb_host = obs_dev.metrics_init(state) if obs_on else None
+    # a resumed RunCheckpoint carries the MetricsBuffer forward, so the
+    # whole-run harvest spans the preemption cut (fresh buffer otherwise)
+    mb_host = ((mbuf_resumed if mbuf_resumed is not None
+                else obs_dev.metrics_init(state)) if obs_on else None)
     sh = None
     if use_mesh and n_dev > 1 and state.arr_ptr.shape[0] % n_dev == 0:
         from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
@@ -371,6 +388,18 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     leap_stats = []  # device LeapStats per compressed chunk, last run's
     mb_chunks = []  # device MetricsBuffer per chunk boundary, last run's
 
+    # the preemption plane (core/preempt.py): an async checkpoint writer
+    # (submit = device-side snapshot at the boundary; serialize + atomic
+    # rename on a background thread — no blocking sync in the dispatch
+    # loop) plus a SIGTERM guard that saves-and-exits at the next boundary
+    ck_writer = None
+    guard = None
+    if ckpt:
+        ck_writer = preempt.AsyncCheckpointer(
+            ckpt, cfg=cfg, plan=plan, policy_digest=pdigest,
+            tick_ms=cfg.tick_ms)
+        guard = preempt.PreemptionGuard().install()
+
     def step_norm(s, a, n, comp, mb):
         """One chunk call with a normalized (state, series?, LeapStats?,
         MetricsBuffer?) return, so the driver loop below keeps a single
@@ -398,6 +427,8 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
         parts = []
         leap_stats.clear()
         mb_chunks.clear()
+        dense_done = 0  # dense-chunk ticks executed so far (resume meta)
+        covered = 0  # ticks covered so far this run
         nxt = put(arr_host[0]) if stream else None
         for i, n in enumerate(chunks):
             a = (nxt if stream else arr_dev[i]) if tick_indexed else arrivals
@@ -420,22 +451,44 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
                 # async, so chunk i+1's H2D rides under chunk i's scan
                 # instead of serializing at the chunk boundary
                 nxt = put(arr_host[i + 1])
-            if save:
-                # simlint: ignore[det-chunk-sync] -- checkpoint durability:
-                # the chunk must be complete on device before it is
-                # serialized, and saves are off in every timed run
-                save_state(jax.block_until_ready(s), ckpt)
+            covered += n
+            if not comp_flags[i]:
+                dense_done += n
+            preempted = guard is not None and guard.triggered
+            if save or preempted:
+                # async checkpoint at the chunk boundary: submit snapshots
+                # the device refs (jnp.copy enqueued before the next
+                # donating dispatch consumes them) and the writer thread
+                # does the blocking gather/serialize/rename — the old
+                # pragma'd blocking sync is gone. Meta carries the resume
+                # cursors; device LeapStats refs are coerced on the
+                # worker, and `prior` telescopes them across resumes.
+                meta = {"chunk_idx": i + 1, "tick": off0 + covered,
+                        "dense_ticks": dense_done,
+                        "leap_stats": list(leap_stats),
+                        "prior": prior_meta}
+                if preempted:
+                    # SIGTERM landed: this boundary is the consistent cut —
+                    # save durably, announce, exit EXIT_PREEMPTED (75)
+                    guard.save_and_exit(ck_writer, s, mbuf=mb, meta=meta)
+                ck_writer.submit(s, mbuf=mb, meta=meta)
         s = jax.block_until_ready(s)
+        if save and ck_writer is not None:
+            # the final boundary's checkpoint must be durable before the
+            # caller trusts the run complete (worker errors re-raise here)
+            ck_writer.flush()
         if not cfg.record_metrics or not parts:  # parts==[]: nothing left
             return s, None
         series = jax.tree.map(
             lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *parts)
         return s, series
 
-    # The first run pays the compile and does the checkpoint saves (ending
-    # with the complete final state on disk); the timed runs keep saves off
-    # so wall_s has no checkpoint I/O and the complete checkpoint isn't
-    # regressed. wall_s is the best of `repeats` timed runs — the TPU here
+    # The first run pays the compile and does the (async) checkpoint saves,
+    # ending with the complete final state durably on disk; the timed runs
+    # keep saves off so wall_s is the pure no-checkpoint baseline, and one
+    # extra saves-on timed run afterwards records the measured async-
+    # checkpointing overhead in the detail (info["checkpoint"]).
+    # wall_s is the best of `repeats` timed runs — the TPU here
     # sits behind a tunnel whose load adds up to 2x run-to-run noise, and
     # min-of-N is the standard way to report the machine's actual speed.
     # Every individual wall lands in info["walls"] so the emitted detail
@@ -443,25 +496,72 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     # spread is tunnel noise; a shifted min is a regression).
     cache_entries_before = (_cache_entries(_COMPILE_CACHE["dir"])
                             if _COMPILE_CACHE["enabled"] else None)
-    t0 = time.time()
-    out, series = run(state, save=bool(ckpt), mb=mb_host)
-    compile_s = time.time() - t0
-    for _ in range(warmups):
-        out, series = run(state, save=False, mb=mb_host)
-        np.asarray(out.t)
-    walls = []
-    for _ in range(repeats):
+    try:
         t0 = time.time()
-        out, series = run(state, save=False, mb=mb_host)
-        # force a host read inside the timer: behind the device tunnel,
-        # block_until_ready has been observed returning early after a very
-        # long (>200 s) preceding compile call, which would record ~0 s
-        # walls for runs whose compute is still in flight
-        np.asarray(out.t)
-        walls.append(time.time() - t0)
-    info["walls"] = walls
-    if warmups:
-        info["warmups"] = warmups
+        out, series = run(state, save=bool(ckpt), mb=mb_host)
+        compile_s = time.time() - t0
+        for _ in range(warmups):
+            out, series = run(state, save=False, mb=mb_host)
+            np.asarray(out.t)
+        walls = []
+        for _ in range(repeats):
+            t0 = time.time()
+            out, series = run(state, save=False, mb=mb_host)
+            # force a host read inside the timer: behind the device
+            # tunnel, block_until_ready has been observed returning early
+            # after a very long (>200 s) preceding compile call, which
+            # would record ~0 s walls for runs whose compute is still in
+            # flight
+            np.asarray(out.t)
+            walls.append(time.time() - t0)
+        info["walls"] = walls
+        if warmups:
+            info["warmups"] = warmups
+        if ckpt and chunks and not _CKPT["resume"] \
+                and min(walls) < _CKPT_AB_MAX_WALL_S:
+            # the async-checkpointing overhead, measured on the artifact:
+            # one more timed run with per-boundary saves ON vs the best
+            # timed no-checkpoint wall (the acceptance instrument for
+            # retiring the old blocking sync); also leaves the final
+            # checkpoint freshly written. Skipped on resumed runs (a
+            # post-preemption continuation should finish, not re-measure)
+            # and on very long rows (the 10M-job record must not pay a
+            # third full pass for a number the churn_bursts row records).
+            writes0, skipped0 = ck_writer.writes, ck_writer.skipped
+            t0 = time.time()
+            out, series = run(state, save=True, mb=mb_host)
+            np.asarray(out.t)
+            ckpt_wall = time.time() - t0
+            ck_writer.flush()
+            info["checkpoint"] = {
+                "async": True, "boundaries": len(chunks),
+                # this measured run's counters only, not the compile run's
+                "writes": ck_writer.writes - writes0,
+                "skipped_latest_wins": ck_writer.skipped - skipped0,
+                "ckpt_wall_s": round(ckpt_wall, 3),
+                "no_ckpt_wall_s": round(min(walls), 3),
+                "overhead_frac": round(
+                    ckpt_wall / max(min(walls), 1e-9) - 1, 4),
+            }
+        elif ckpt and chunks:
+            info["checkpoint"] = {
+                "async": True, "boundaries": len(chunks),
+                "writes": ck_writer.writes,
+                "skipped_latest_wins": ck_writer.skipped,
+                "overhead_note": ("A/B skipped: resumed run or wall over "
+                                  f"{_CKPT_AB_MAX_WALL_S} s"),
+            }
+        if ck_writer is not None:
+            ck_writer.close()  # surfaces any pending writer error
+    finally:
+        # never leak the SIGTERM handler or the writer thread past an
+        # exception (and make the guard inert for the obs-ab runs below —
+        # a post-uninstall SIGTERM must not route into a closed writer)
+        if guard is not None:
+            guard.uninstall()
+            guard = None
+        if ck_writer is not None:
+            ck_writer.abort()
     if obs_on and mb_chunks:
         # harvest: one global view off the last timed run's final buffer
         # (under a mesh the partials reduce through the exchange first);
@@ -523,18 +623,21 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     if tick_indexed:
         # time-compression provenance: executed vs simulated ticks and the
         # log2 leap histogram (bucket b = leaps of [2^b, 2^(b+1)) ticks) —
-        # the DES win auditable from BENCH history alone
-        executed = sum(n for n, c in zip(chunks, comp_flags) if not c)
-        executed += sum(int(np.asarray(ls.ticks_executed))
-                        for ls in leap_stats)
-        tc = {"mode": tc_mode, "ticks_simulated": sum(chunks),
+        # the DES win auditable from BENCH history alone. On a resumed run
+        # the loaded RunCheckpoint's cursors are folded in, so the numbers
+        # cover the WHOLE logical run and telescope to exactly what an
+        # uninterrupted run reports (tools/chaos.py --batch asserts it).
+        executed, leap_hist = preempt.fold_cursors(
+            sum(n for n, c in zip(chunks, comp_flags) if not c),
+            leap_stats, prior_meta)
+        tc = {"mode": tc_mode, "ticks_simulated": off0 + sum(chunks),
               "ticks_executed": executed,
               "compressed_chunks": int(sum(comp_flags))}
-        if leap_stats:
-            hist = np.sum([np.asarray(ls.leaps) for ls in leap_stats],
-                          axis=0)
-            nz = np.flatnonzero(hist)
-            tc["leap_hist_log2"] = hist[:nz[-1] + 1].tolist() if len(nz) else []
+        if leap_hist:
+            tc["leap_hist_log2"] = leap_hist
+        if prior_meta:
+            tc["resumed_prior_ticks_executed"] = int(
+                prior_meta.get("ticks_executed", 0))
         info["time_compress"] = tc
     # pipeline provenance + data-movement accounting: h2d_bytes is what ONE
     # timed run moved host->device (0 when the stream is resident across
@@ -575,7 +678,7 @@ def _timing_detail(info):
     for k in ("pipeline", "h2d_bytes", "arrivals_bytes",
               "peak_hbm_process_bytes", "compile_cache", "time_compress",
               "state_bytes", "tick_bytes_accessed", "tick_bytes_note",
-              "compact", "policy", "mesh_devices", "obs"):
+              "compact", "policy", "mesh_devices", "obs", "checkpoint"):
         if info.get(k) is not None:
             out[k] = info[k]
     return out
@@ -1787,7 +1890,49 @@ def bench_scale16k(quick=False):
                               repeats=2, extra_note="4x north-star scale")
 
 
-def bench_sparse_bursts(quick=False):
+def churn_bursts_setup(quick=False):
+    """The ``churn_bursts`` shape: the sparse-burst trace with
+    deterministic trace-mode node churn landing INSIDE the burst windows —
+    a node fails 5 s into each burst and repairs 15 s in, so kills are
+    guaranteed (jobs are running then) while the valleys stay quiescent
+    and the leap driver keeps engaging. One definition shared with the
+    batch chaos harness (tools/chaos.py --batch builds the reference
+    template from it), so the chaos gate can never drift onto a different
+    workload than the bench it kills. Returns ``(cfg, specs, arrivals,
+    n_ticks, fault_events)``."""
+    import dataclasses as _dc
+
+    from multi_cluster_simulator_tpu.config import (
+        FaultConfig, PolicyKind, SimConfig,
+    )
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    from multi_cluster_simulator_tpu.workload.traces import bursty_stream
+
+    C = 64 if quick else 256
+    bursts, per_burst = (5, 10) if quick else (12, 24)
+    interval_ms, window_ms = 300_000, 20_000
+    horizon_ms = bursts * interval_ms
+    cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=32,
+                    max_running=64, max_arrivals=bursts * per_burst,
+                    max_ingest_per_tick=16, parity=True, n_res=2,
+                    max_nodes=5, max_virtual_nodes=0)
+    # retry budget deep enough that no job exhausts it (drops.failed must
+    # stay zero so every drop counter still gates the run)
+    cfg = _dc.replace(cfg, faults=FaultConfig(
+        enabled=True, mode="trace", max_retries=16, max_events=bursts))
+    fault_events = [(c, b % cfg.max_nodes,
+                     b * interval_ms + 5_000, b * interval_ms + 15_000)
+                    for c in range(0, C, max(C // 8, 1))
+                    for b in range(bursts)]
+    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+    arrivals = bursty_stream(C, bursts, per_burst, interval_ms, window_ms,
+                             max_cores=8, max_mem=6_000, max_dur_ms=60_000,
+                             seed=11)
+    n_ticks = horizon_ms // cfg.tick_ms + 70  # drain tail
+    return cfg, specs, arrivals, n_ticks, fault_events
+
+
+def bench_sparse_bursts(quick=False, churn=False):
     """The event-compression config: a burst-sparse trace (Borg-sparsity
     regime) where the vast majority of ticks are provably no-ops — jobs
     arrive in 20 s bursts every 5 minutes and fully drain between them, so
@@ -1796,54 +1941,83 @@ def bench_sparse_bursts(quick=False):
     quiescent valleys. The detail's ``time_compress`` block records
     ticks_executed vs ticks_simulated + the leap histogram; run with
     ``--time-compress ab`` to record the measured dense-vs-compressed wall
-    comparison on this exact shape."""
+    comparison on this exact shape.
+
+    ``churn=True`` is the ``churn_bursts`` config (churn_bursts_setup):
+    the same trace with deterministic in-burst node churn composed — the
+    resumable run the batch chaos harness kill -9s at chunk boundaries
+    (tools/chaos.py --batch), with compact state, event compression, and
+    the fault plane all engaged. Smaller chunks (more boundaries to kill
+    at) and one repeat (robustness config, not a perf headline)."""
     from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
     from multi_cluster_simulator_tpu.core.spec import uniform_cluster
     from multi_cluster_simulator_tpu.workload.traces import bursty_stream
 
-    C = 64 if quick else 1024
-    bursts, per_burst = (5, 10) if quick else (12, 24)
-    interval_ms, window_ms = 300_000, 20_000
-    horizon_ms = bursts * interval_ms
-    # FIFO parity semantics (the headline's mode): bounds sized to the
-    # burst shape — per_burst jobs spread over a 20-tick window back up a
-    # few deep at most (the zero-drops assert below is the guard);
-    # durations <= 60 s guarantee full drain inside each 300 s valley
-    cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=32,
-                    max_running=64, max_arrivals=bursts * per_burst,
-                    max_ingest_per_tick=16, parity=True, n_res=2,
-                    max_nodes=5, max_virtual_nodes=0)
-    specs = [uniform_cluster(c + 1, 5) for c in range(C)]
-    arrivals = bursty_stream(C, bursts, per_burst, interval_ms, window_ms,
-                             max_cores=8, max_mem=6_000, max_dur_ms=60_000,
-                             seed=11)
-    n_ticks = horizon_ms // cfg.tick_ms + 70  # drain tail
-    out, wall_s, compile_s, _, info = _engine_run(cfg, specs, arrivals,
-                                                  n_ticks, use_mesh=True,
-                                                  chunk=400, repeats=3,
-                                                  warmups=1,
-                                                  tick_indexed=True)
+    fault_events = None
+    if churn:
+        cfg, specs, arrivals, n_ticks, fault_events = churn_bursts_setup(
+            quick)
+        C = len(specs)
+        bursts = cfg.faults.max_events
+        per_burst = cfg.max_arrivals // bursts
+    else:
+        C = 64 if quick else 1024
+        bursts, per_burst = (5, 10) if quick else (12, 24)
+        interval_ms, window_ms = 300_000, 20_000
+        horizon_ms = bursts * interval_ms
+        # FIFO parity semantics (the headline's mode): bounds sized to the
+        # burst shape — per_burst jobs spread over a 20-tick window back
+        # up a few deep at most (the zero-drops assert below is the
+        # guard); durations <= 60 s guarantee full drain inside each
+        # 300 s valley
+        cfg = SimConfig(policy=PolicyKind.FIFO, queue_capacity=32,
+                        max_running=64, max_arrivals=bursts * per_burst,
+                        max_ingest_per_tick=16, parity=True, n_res=2,
+                        max_nodes=5, max_virtual_nodes=0)
+        specs = [uniform_cluster(c + 1, 5) for c in range(C)]
+        arrivals = bursty_stream(C, bursts, per_burst, interval_ms,
+                                 window_ms, max_cores=8, max_mem=6_000,
+                                 max_dur_ms=60_000, seed=11)
+        n_ticks = horizon_ms // cfg.tick_ms + 70  # drain tail
+    out, wall_s, compile_s, _, info = _engine_run(
+        cfg, specs, arrivals, n_ticks, use_mesh=True,
+        chunk=100 if churn else 400, repeats=1 if churn else 3,
+        warmups=0 if churn else 1, tick_indexed=True,
+        fault_events=fault_events)
     placed = int(np.asarray(out.placed_total).sum())
     total = C * bursts * per_burst
     assert placed >= 0.99 * total, f"only {placed}/{total} jobs placed"
-    _assert_zero_drops(out, "sparse_bursts")
+    label = "churn_bursts" if churn else "sparse_bursts"
+    _assert_zero_drops(out, label)
     tc = info.get("time_compress", {})
     if _TIME_COMPRESS["mode"] != "off":
         assert tc.get("ticks_executed", n_ticks) < tc.get(
             "ticks_simulated", n_ticks), (
-            "sparse_bursts: the leap driver executed every tick — "
+            f"{label}: the leap driver executed every tick — "
             f"compression never engaged ({tc})")
+    detail = {"jobs": placed, "clusters": C,
+              "wall_s": round(wall_s, 3),
+              "compile_s": round(compile_s, 1),
+              "sim_horizon_s": n_ticks,
+              **_timing_detail(info)}
+    if churn:
+        # the fault plane must ENGAGE (a chaos gate over a churn-free run
+        # proves nothing) and never exhaust the deep retry budget
+        kills = int(np.asarray(out.faults.kills).sum())
+        requeues = int(np.asarray(out.faults.requeues).sum())
+        assert kills > 0 and requeues > 0, (
+            f"churn_bursts: {kills} kills / {requeues} requeues — the "
+            "fault plane never engaged")
+        detail.update(fault_kills=kills, fault_requeues=requeues,
+                      node_down_ms=int(np.asarray(out.faults.down_ms).sum()))
     rate = (placed - info["placed_before_resume"]) / max(wall_s, 1e-9)
     return {
-        "metric": "sparse_burst_trace_jobs_per_sec",
+        "metric": ("churn_bursts_jobs_per_sec" if churn
+                   else "sparse_burst_trace_jobs_per_sec"),
         "value": round(rate, 1),
         "unit": "jobs/s",
         "vs_baseline": round(rate / (1_000_000 / 60.0), 3),
-        "detail": {"jobs": placed, "clusters": C,
-                   "wall_s": round(wall_s, 3),
-                   "compile_s": round(compile_s, 1),
-                   "sim_horizon_s": n_ticks,
-                   **_timing_detail(info)},
+        "detail": detail,
     }
 
 
@@ -2364,6 +2538,8 @@ CONFIGS = {
     "borg4k": bench_borg4k,
     "borg_replay": bench_borg_replay,
     "sparse_bursts": bench_sparse_bursts,
+    "churn_bursts": lambda quick=False: bench_sparse_bursts(quick,
+                                                            churn=True),
     "live": bench_live,
     "serving": bench_serving,
     "tournament": bench_tournament,
@@ -2425,9 +2601,16 @@ def main():
     ap.add_argument("--quick", action="store_true",
                     help="shrunk shapes for smoke-testing the harness")
     ap.add_argument("--checkpoint", metavar="PATH",
-                    help="save state to PATH after every jitted chunk")
+                    help="save a RunCheckpoint to PATH after every jitted "
+                         "chunk — asynchronously (device-ref snapshot at "
+                         "the boundary, serialize + atomic-rename on a "
+                         "background thread; core/preempt.py). SIGTERM "
+                         "saves-and-exits cleanly (exit 75) at the next "
+                         "boundary")
     ap.add_argument("--resume", action="store_true",
-                    help="resume from --checkpoint if it exists (bit-exact)")
+                    help="resume from --checkpoint if it exists (bit-exact;"
+                         " a wrong-config/plan/policy checkpoint fails "
+                         "fast with the differing field named)")
     ap.add_argument("--trace", metavar="PATH",
                     help="Borg-2019 trace file for --config borg_replay "
                          "(instance_events JSONL/CSV or pre-joined jobs CSV)")

@@ -6,19 +6,34 @@ contract lives in process memory and a restart loses the world (SURVEY.md
 ``SimState`` pytree (core/state.py), so a checkpoint is a single
 serialization call and resume is bit-exact: the virtual clock, every queue
 tensor, the running set, the arrival cursors (``arr_ptr``), drop counters,
-and trader snapshots all round-trip. Long Borg-trace replays (bench.py
---checkpoint/--resume) can be killed at any jitted-chunk boundary and
-continued to a final state identical to an uninterrupted run
-(tests/test_checkpoint.py).
+fault-plane churn clocks, and trader snapshots all round-trip. Long
+Borg-trace replays (bench.py --checkpoint/--resume) can be killed at any
+jitted-chunk boundary and continued to a final state identical to an
+uninterrupted run (tests/test_checkpoint.py; tools/chaos.py --batch is the
+standing kill -9 proof).
 
-Format: flax msgpack (``flax.serialization.to_bytes``) with a small JSON
-header carrying a magic/version tag. Loading requires a template state
-built from the same ``SimConfig``/specs (static shapes are config-derived,
-not stored).
+Format (version 2): flax msgpack (``flax.serialization.to_bytes``) behind a
+JSON header that is LOAD-BEARING, not advisory. Besides the virtual clock
+and the caller's ``extra`` dict, the header embeds the format version and —
+when the writer supplies them — the full ``SimConfig`` description, the
+compact storage plan, and the policy-params digest. ``load_state`` rejects
+a version or digest mismatch with a message NAMING the differing field:
+leaf shapes/dtypes alone cannot tell an undersized stale compact plan from
+the right one (both produce i16 leaves; only the audited bounds differ),
+and a wrong-config resume must fail fast instead of silently corrupting a
+multi-hour run. Loading requires a template state built from the same
+``SimConfig``/specs (static shapes are config-derived, not stored).
+
+The run-level bundle that wraps a state together with the obs
+``MetricsBuffer`` carry and the driver's resume cursors lives in
+core/preempt.py (``RunCheckpoint``) and rides the same format through
+``save_tree``/``load_tree``.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import json
 import os
 import struct as _struct
@@ -32,38 +47,183 @@ from flax import serialization
 from multi_cluster_simulator_tpu.core.state import SimState
 
 _MAGIC = b"MCSCKPT1"
+# bumped whenever the header contract changes; v1 (the pre-digest format
+# whose header was advisory) is refused — a stale checkpoint must be
+# re-created, never trusted on shapes alone
+FORMAT_VERSION = 2
+
+# distinguishes "caller did not supply a plan to check" from "caller
+# asserts the wide layout (plan None)" — the two must not be conflated:
+# resuming a compact run into a wide template is exactly the class of
+# mismatch the digest exists to catch
+_UNSET = object()
 
 
-def save_state(state: SimState, path: str, extra: Optional[dict] = None) -> None:
-    """Write a checkpoint. Atomic: written to ``path + '.tmp'`` then
-    renamed, so a kill mid-write never corrupts an existing checkpoint.
+# --------------------------------------------------------------------------
+# digests: canonical descriptions of what a checkpoint is only valid for
+# --------------------------------------------------------------------------
 
-    ``extra`` is an arbitrary JSON-able dict stored in the header — hosts
-    use it for state the tensors can't carry (borrower URL table, pending
-    jobs); keeping it in the same file keeps the pair atomic."""
-    state = jax.tree.map(np.asarray, state)  # device -> host once
-    payload = serialization.to_bytes(state)
-    header = json.dumps({"t": int(state.t), "extra": extra or {}}).encode()
+
+def _canon_json(obj) -> str:
+    """Canonical JSON for digesting/diffing: dataclasses and str-enums
+    serialize naturally (every config enum is a str subclass), keys sort."""
+    return json.dumps(obj, sort_keys=True)
+
+
+def config_describe(cfg) -> dict:
+    """The full nested ``SimConfig`` as plain JSON-able data — stored in
+    the header so a mismatch can name the differing FIELD, not just fail
+    a hash compare."""
+    return dataclasses.asdict(cfg)
+
+
+def digest_of(obj) -> str:
+    """sha1[:12] of the canonical JSON form — THE digest recipe every
+    validity record in this repo uses (config, compact plan, the
+    tournament's grid digest), so conventions cannot drift apart."""
+    return hashlib.sha1(_canon_json(obj).encode()).hexdigest()[:12]
+
+
+def config_digest(cfg) -> str:
+    return digest_of(config_describe(cfg))
+
+
+def plan_describe(plan) -> Optional[dict]:
+    """The compact storage plan (core/compact.CompactPlan) as JSON-able
+    data; ``None`` is the wide layout and is itself a checkable value."""
+    if plan is None:
+        return None
+    return {"queue": list(map(list, plan.queue)),
+            "run": list(map(list, plan.run)), "node": plan.node}
+
+
+def plan_digest(plan) -> str:
+    return digest_of(plan_describe(plan))
+
+
+def _dict_diff(want: dict, got: dict, prefix="") -> list:
+    """Dotted paths where two nested config/plan descriptions differ —
+    the 'message naming the differing field' half of header hardening."""
+    out = []
+    for k in sorted(set(want) | set(got)):
+        w, g = want.get(k, "<absent>"), got.get(k, "<absent>")
+        if isinstance(w, dict) and isinstance(g, dict):
+            out.extend(_dict_diff(w, g, prefix=f"{prefix}{k}."))
+        elif w != g:
+            out.append(f"{prefix}{k} (checkpoint: {g!r}, expected: {w!r})")
+    return out
+
+
+def _check_header(header: dict, path: str, cfg=None, plan=_UNSET,
+                  policy_digest: Optional[str] = None) -> None:
+    v = header.get("v", 1)
+    if v != FORMAT_VERSION:
+        raise ValueError(
+            f"{path}: checkpoint format v{v}; this build reads "
+            f"v{FORMAT_VERSION} — re-create the checkpoint")
+    if cfg is not None:
+        if "config" not in header:
+            raise ValueError(
+                f"{path}: checkpoint carries no SimConfig record; cannot "
+                "verify it matches the resuming config — re-create it with "
+                "save_state(..., cfg=...)")
+        # JSON round-trip the expected side too: the header came through
+        # JSON (tuples are lists there), so both sides must compare in
+        # the same canonical form
+        want = json.loads(_canon_json(config_describe(cfg)))
+        diffs = _dict_diff(want, header["config"])
+        if diffs:
+            raise ValueError(
+                f"{path}: checkpoint was written under a different "
+                f"SimConfig — differing field(s): " + "; ".join(diffs[:8]))
+    if plan is not _UNSET:
+        if "plan" not in header:
+            raise ValueError(
+                f"{path}: checkpoint carries no compact-plan record; "
+                "cannot verify the storage layout — re-create it with "
+                "save_state(..., plan=...)")
+        want, got = plan_describe(plan), header["plan"]
+        if want != got:
+            if (want is None) != (got is None):
+                detail = (f"checkpoint layout: "
+                          f"{'wide' if got is None else 'compact'}, "
+                          f"expected: {'wide' if want is None else 'compact'}")
+            else:
+                diffs = _dict_diff(want, got)
+                detail = "differing field(s): " + "; ".join(diffs[:8])
+            raise ValueError(
+                f"{path}: checkpoint was written under a different compact "
+                f"storage plan — {detail}")
+    if policy_digest is not None:
+        got = header.get("policy_digest")
+        if got != policy_digest:
+            raise ValueError(
+                f"{path}: checkpoint was written under different policy "
+                f"params (digest {got!r}, expected {policy_digest!r})")
+
+
+# --------------------------------------------------------------------------
+# low-level framed I/O (shared by state checkpoints and run bundles)
+# --------------------------------------------------------------------------
+
+
+def _write(path: str, header: dict, payload: bytes) -> None:
+    """Atomic framed write: magic, header length, JSON header, msgpack
+    payload — to ``path + '.tmp'`` then ``os.replace``, so a kill at ANY
+    byte of the write never corrupts an existing checkpoint (the torn-write
+    contract tests/test_checkpoint.py pins)."""
+    hdr = json.dumps(header).encode()
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(_MAGIC)
-        f.write(_struct.pack("<I", len(header)))
-        f.write(header)
+        f.write(_struct.pack("<I", len(hdr)))
+        f.write(hdr)
         f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 
-def load_state(path: str, template: SimState) -> SimState:
-    """Restore a checkpoint into the shapes of ``template`` (normally
-    ``init_state(cfg, specs)`` for the same config). Shape/dtype mismatches
-    raise — a checkpoint is only valid for the config that produced it."""
+def _read(path: str) -> tuple[dict, bytes]:
     with open(path, "rb") as f:
         magic = f.read(len(_MAGIC))
         if magic != _MAGIC:
             raise ValueError(f"{path}: not a simulator checkpoint")
         (hlen,) = _struct.unpack("<I", f.read(4))
-        f.read(hlen)  # header is advisory (peek_checkpoint_t)
+        header = json.loads(f.read(hlen))
         payload = f.read()
+    return header, payload
+
+
+def save_tree(tree, path: str, t: int, extra: Optional[dict] = None,
+              cfg=None, plan=_UNSET,
+              policy_digest: Optional[str] = None) -> None:
+    """Write an arbitrary pytree checkpoint (the generic core behind
+    ``save_state`` and the run bundles). ``t`` is the virtual clock stored
+    for ``peek_checkpoint_t``; ``cfg``/``plan``/``policy_digest`` embed the
+    validity record the loader verifies."""
+    tree = jax.tree.map(np.asarray, tree)  # device -> host once
+    header = {"v": FORMAT_VERSION, "t": int(t), "extra": extra or {}}
+    if cfg is not None:
+        header["config"] = config_describe(cfg)
+        header["config_digest"] = config_digest(cfg)
+    if plan is not _UNSET:
+        header["plan"] = plan_describe(plan)
+        header["plan_digest"] = plan_digest(plan)
+    if policy_digest is not None:
+        header["policy_digest"] = policy_digest
+    _write(path, header, serialization.to_bytes(tree))
+
+
+def load_tree(path: str, template, cfg=None, plan=_UNSET,
+              policy_digest: Optional[str] = None):
+    """Restore a pytree checkpoint into the shapes of ``template``. The
+    header is verified FIRST (version, then config/plan/policy when the
+    caller supplies them — a named-field mismatch beats a shape error),
+    then every leaf's shape/dtype is checked against the template."""
+    header, payload = _read(path)
+    _check_header(header, path, cfg=cfg, plan=plan,
+                  policy_digest=policy_digest)
     restored = serialization.from_bytes(template, payload)
     for a, b in zip(jax.tree.leaves(template), jax.tree.leaves(restored)):
         if np.shape(a) != np.shape(b) or np.asarray(a).dtype != np.asarray(b).dtype:
@@ -72,6 +232,36 @@ def load_state(path: str, template: SimState) -> SimState:
                 f" vs {np.shape(a)}/{np.asarray(a).dtype} "
                 "— was it written under a different SimConfig?")
     return jax.tree.map(jnp.asarray, restored)
+
+
+# --------------------------------------------------------------------------
+# the classic SimState checkpoint surface
+# --------------------------------------------------------------------------
+
+
+def save_state(state: SimState, path: str, extra: Optional[dict] = None,
+               cfg=None, plan=_UNSET,
+               policy_digest: Optional[str] = None) -> None:
+    """Write a SimState checkpoint. Atomic (tmp + rename — see ``_write``).
+
+    ``extra`` is an arbitrary JSON-able dict stored in the header — hosts
+    use it for state the tensors can't carry (borrower URL table, pending
+    jobs); keeping it in the same file keeps the pair atomic.
+    ``cfg``/``plan``/``policy_digest`` embed the validity record
+    ``load_state`` verifies (pass them wherever they are known — the
+    serving tier and the batch drivers both do)."""
+    save_tree(state, path, t=int(np.asarray(state.t)), extra=extra, cfg=cfg,
+              plan=plan, policy_digest=policy_digest)
+
+
+def load_state(path: str, template: SimState, cfg=None, plan=_UNSET,
+               policy_digest: Optional[str] = None) -> SimState:
+    """Restore a checkpoint into the shapes of ``template`` (normally
+    ``init_state(cfg, specs)`` for the same config). Version, digest, and
+    shape/dtype mismatches all raise — a checkpoint is only valid for the
+    config (and storage plan, and policy params) that produced it."""
+    return load_tree(path, template, cfg=cfg, plan=plan,
+                     policy_digest=policy_digest)
 
 
 def _read_header(path: str) -> dict:
@@ -84,7 +274,8 @@ def _read_header(path: str) -> dict:
 
 def peek_checkpoint_t(path: str) -> int:
     """The checkpoint's virtual time (ms) without deserializing the state —
-    lets a driver compute how many ticks remain before paying the load."""
+    lets a driver compute how many ticks remain before paying the load
+    (tools/chaos.py --batch also uses it to watch a child's progress)."""
     return int(_read_header(path)["t"])
 
 

@@ -1,0 +1,367 @@
+"""The preemption plane: resumable bit-identical long runs for the batch tier.
+
+PR 13 made the *serving* tier crash-proof (WAL + checkpointed watermark);
+this module is the batch half — on TPU pods preemption is the dominant
+failure mode, and a multi-hour sharded record must be a restartable unit,
+not an all-or-nothing job (the Blox framing, arxiv 2312.12621). Three
+pieces, composed by the chunked drivers (bench._engine_run,
+tools/weak_scaling.py) and chaos-gated by ``tools/chaos.py --batch``:
+
+- **RunCheckpoint** — the widened checkpoint bundle: everything a resumed
+  run needs to be bit-exact AND to report whole-run provenance. SimState
+  (which carries the fault plane's churn clocks ``next_fail``/
+  ``down_until``, retry budgets, and interval cursors — churn is state, so
+  it rides for free), the obs ``MetricsBuffer`` carry (so a resumed run's
+  harvest covers the whole logical run), and the driver's resume cursors:
+  completed tick, chunk index (the stream position ``pack_arrivals_chunks``
+  re-buckets from via ``start=``), and the time-compression provenance
+  accumulated so far (``ticks_executed`` + the log2 leap histogram), which
+  telescopes across kill/resume cycles to exactly the uninterrupted run's
+  totals. The header embeds the SimConfig/compact-plan/policy-params
+  validity record (core/checkpoint.py v2), so a wrong-config or
+  wrong-plan resume fails fast with a named field.
+
+- **AsyncCheckpointer** — checkpoint writes OFF the dispatch path. At a
+  chunk boundary the driver ``submit``s the live device refs; submit takes
+  a device-side snapshot (``jnp.copy`` — an async device op enqueued
+  BEFORE the next chunk's donating dispatch can consume the buffers) and
+  returns immediately; a background worker thread then blocks on the
+  snapshot, gathers it to host (a sharded state's global leaves gather
+  across the addressable mesh), serializes, and atomic-renames. This
+  retires the pragma'd blocking ``block_until_ready`` + synchronous
+  ``save_state`` the bench chunk loop used to pay per boundary (the one
+  sanctioned ``det-chunk-sync`` suppression — gone). Submissions are
+  latest-wins: if the disk cannot keep up, intermediate snapshots are
+  skipped (counted), never queued without bound — a skipped checkpoint
+  only means a resume redoes more ticks, still bit-identically.
+
+- **PreemptionGuard** — SIGTERM (the preemption signal pods actually get)
+  sets a flag the driver checks at every chunk boundary: save, flush,
+  and exit ``EXIT_PREEMPTED`` cleanly. kill -9 needs no handler — the
+  latest atomic checkpoint is the resume point (tools/chaos.py --batch
+  proves both paths).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import signal
+import sys
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multi_cluster_simulator_tpu.core import checkpoint as ck
+from multi_cluster_simulator_tpu.core.state import LEAP_BUCKETS
+
+# sysexits EX_TEMPFAIL: "try again later" — the conventional exit code for
+# a clean save-and-exit under preemption; schedulers treat it as retryable
+EXIT_PREEMPTED = 75
+
+_UNSET = ck._UNSET
+
+
+def policy_digest_for(cfg) -> str:
+    """The default policy-params digest a config-built engine runs with —
+    what the checkpoint header records so a resume under edited policy
+    parameters fails fast (params are DATA, so shapes alone cannot catch
+    it). Matches ``Engine(cfg).policy_provenance()['params_digest']``."""
+    from multi_cluster_simulator_tpu.policies.base import (
+        PolicySet, params_digest,
+    )
+    pset = PolicySet.from_config(cfg)
+    return params_digest(pset.params_for(cfg))
+
+
+@dataclasses.dataclass
+class RunCheckpoint:
+    """A loaded run bundle: the restored state (+ optional MetricsBuffer
+    carry) and the resume cursors from the header."""
+
+    state: Any
+    mbuf: Any  # MetricsBuffer or None
+    meta: dict  # tick, chunk_idx, ticks_executed, leap_hist, ...
+
+    @property
+    def tick(self) -> int:
+        return int(self.meta.get("tick", 0))
+
+
+def fold_cursors(dense_ticks: int, leap_stats, prior: Optional[dict] = None
+                 ) -> tuple[int, list]:
+    """THE telescoping fold for the time-compression cursors — one
+    definition, used by both the checkpoint writer (``_finalize_meta``)
+    and the bench detail reporting, so the chaos gate's
+    cursors-must-telescope assertion can never drift against the numbers
+    the detail prints. Host-side (coerces device LeapStats refs).
+    Returns ``(ticks_executed, leap_hist)``: this run's dense-chunk ticks
+    plus the compressed chunks' executed ticks, accumulated onto the
+    ``prior`` cursors a resume loaded."""
+    prior = prior or {}
+    executed = int(dense_ticks)
+    hist = np.zeros((LEAP_BUCKETS,), np.int64)
+    for ls in leap_stats or []:
+        executed += int(np.asarray(ls.ticks_executed))
+        hist += np.asarray(ls.leaps, np.int64)
+    prior_hist = prior.get("leap_hist") or []
+    hist[: len(prior_hist)] += np.asarray(prior_hist, np.int64)
+    executed += int(prior.get("ticks_executed", 0))
+    nz = np.flatnonzero(hist)
+    return executed, (hist[: nz[-1] + 1].tolist() if len(nz) else [])
+
+
+def _finalize_meta(meta: dict) -> dict:
+    """Resolve the device-ref provenance a submit carried into host ints —
+    runs on the WORKER thread (host coercions here never stall the
+    dispatch loop). ``dense_ticks`` counts this run's dense-chunk ticks;
+    ``leap_stats`` is the compressed chunks' device LeapStats list;
+    ``prior`` is the meta loaded at resume, so the cursors accumulate
+    across kill/resume cycles exactly like the state does."""
+    meta = dict(meta)
+    prior = meta.pop("prior", None) or {}
+    leap_stats = meta.pop("leap_stats", None) or []
+    executed, hist = fold_cursors(meta.pop("dense_ticks", 0), leap_stats,
+                                  prior)
+    meta["ticks_executed"] = executed
+    meta["leap_hist"] = hist
+    return meta
+
+
+def save_run(path: str, state, mbuf=None, meta: Optional[dict] = None,
+             cfg=None, plan=_UNSET, policy_digest: Optional[str] = None,
+             tick_ms: int = 1000) -> None:
+    """Write a RunCheckpoint synchronously (the AsyncCheckpointer's worker
+    calls this; tests and small drivers call it directly). ``meta`` may
+    carry device refs under ``leap_stats``/``dense_ticks``/``prior`` —
+    they are resolved here, host-side."""
+    meta = _finalize_meta(meta or {})
+    mbuf = _reduce_mbuf_partials(mbuf)
+    bundle = {"state": state}
+    if mbuf is not None:
+        bundle["mbuf"] = mbuf
+    meta.setdefault("tick", int(np.asarray(state.t)) // max(int(tick_ms), 1))
+    ck.save_tree(bundle, path, t=int(np.asarray(state.t)),
+                 extra={"run": {**meta, "has_mbuf": mbuf is not None}},
+                 cfg=cfg, plan=plan, policy_digest=policy_digest)
+
+
+def _reduce_mbuf_partials(mbuf):
+    """Fold a MetricsBuffer's shard-local partial leaves (leading axis =
+    one row per shard) down to a single row before serializing: totals are
+    preserved, and the saved buffer becomes MESH-INDEPENDENT — a run
+    checkpointed on the 8-device mesh resumes on 1 device (or vice versa)
+    with ``ShardedEngine.shard_metrics`` re-widening row 0 + zeros. The
+    contract for the obs carry across a cut is harvest-equality, which the
+    reduction preserves exactly (harvest sums the shard axis anyway)."""
+    if mbuf is None:
+        return None
+    host = jax.tree.map(np.asarray, mbuf)
+
+    def fold(a):  # keep the storage dtype (np.sum promotes to int64)
+        return a.sum(axis=0, keepdims=True, dtype=a.dtype)
+
+    return host.replace(depth_hist=fold(host.depth_hist),
+                        ring_placed=fold(host.ring_placed),
+                        ring_depth=fold(host.ring_depth))
+
+
+def load_run(path: str, state_template, cfg=None, plan=_UNSET,
+             policy_digest: Optional[str] = None) -> RunCheckpoint:
+    """Load a RunCheckpoint (header verified first — version, config,
+    plan, policy). The MetricsBuffer template is derived from the state
+    template (``obs.metrics_init``), so callers need no obs plumbing to
+    restore a buffer-carrying bundle."""
+    header = ck._read_header(path)
+    ck._check_header(header, path, cfg=cfg, plan=plan,
+                     policy_digest=policy_digest)
+    run_meta = dict((header.get("extra") or {}).get("run") or {})
+    has_mbuf = bool(run_meta.pop("has_mbuf", False))
+    template = {"state": state_template}
+    if has_mbuf:
+        from multi_cluster_simulator_tpu.obs.device import metrics_init
+        template["mbuf"] = metrics_init(state_template)
+    bundle = ck.load_tree(path, template, cfg=cfg, plan=plan,
+                          policy_digest=policy_digest)
+    return RunCheckpoint(state=bundle["state"], mbuf=bundle.get("mbuf"),
+                         meta=run_meta)
+
+
+class AsyncCheckpointer:
+    """Background-thread checkpoint writer for chunked drivers.
+
+    ``submit`` is what the dispatch loop calls at a chunk boundary: it
+    snapshots the live device refs with ``jnp.copy`` (async device-side
+    copies, enqueued before the next chunk's donating dispatch can consume
+    the originals — donation safety is exactly why the snapshot exists)
+    and hands them to the worker. All blocking work — waiting for the
+    snapshot to compute, the device→host gather, serialization, fsync,
+    atomic rename — happens on the worker thread. ``flush`` drains the
+    queue and re-raises any worker error; call it after the run loop (and
+    before trusting the final checkpoint).
+
+    Latest-wins: a submit that arrives while an older snapshot is still
+    waiting REPLACES it (``skipped`` counts them). The final submit of a
+    run is therefore always written; intermediate cadence under a slow
+    disk degrades to sparser resume points, never to unbounded memory or
+    a stalled dispatch loop."""
+
+    def __init__(self, path: str, cfg=None, plan=_UNSET,
+                 policy_digest: Optional[str] = None, tick_ms: int = 1000,
+                 save_fn=None):
+        self.path = path
+        self._cfg, self._plan, self._pdigest = cfg, plan, policy_digest
+        self._tick_ms = tick_ms
+        self._save_fn = save_fn if save_fn is not None else save_run
+        self._cond = threading.Condition()
+        self._pending = None  # (state_snap, mbuf_snap, meta) — latest wins
+        self._busy = False
+        self._stop = False
+        self._error: Optional[BaseException] = None
+        self.writes = 0
+        self.skipped = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="mcs-ckpt-writer")
+        self._thread.start()
+
+    def submit(self, state, mbuf=None, meta: Optional[dict] = None) -> None:
+        snap_state = jax.tree.map(jnp.copy, state)
+        snap_mbuf = (jax.tree.map(jnp.copy, mbuf)
+                     if mbuf is not None else None)
+        with self._cond:
+            if self._error is not None:
+                raise RuntimeError(
+                    "async checkpoint writer already failed"
+                ) from self._error
+            if self._pending is not None:
+                self.skipped += 1
+            self._pending = (snap_state, snap_mbuf, dict(meta or {}))
+            self._cond.notify_all()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stop:
+                    self._cond.wait()
+                if self._pending is None and self._stop:
+                    return
+                state, mbuf, meta = self._pending
+                self._pending = None
+                self._busy = True
+            try:
+                self._save_fn(self.path, state, mbuf=mbuf, meta=meta,
+                              cfg=self._cfg, plan=self._plan,
+                              policy_digest=self._pdigest,
+                              tick_ms=self._tick_ms)
+                with self._cond:
+                    self.writes += 1
+            except BaseException as e:  # surfaced by flush/close
+                with self._cond:
+                    if self._error is None:
+                        self._error = e
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every submitted snapshot is durably on disk (or the
+        worker failed — the stored error re-raises here, never silently)."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: (self._pending is None and not self._busy)
+                or self._error is not None, timeout=timeout)
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise RuntimeError(
+                    f"async checkpoint write to {self.path} failed") from err
+            if self._pending is not None or self._busy:
+                raise TimeoutError(
+                    f"async checkpoint flush timed out after {timeout}s")
+
+    def close(self) -> None:
+        """Flush (raising any stored worker error), then stop the worker.
+        Idempotent; ``abort`` afterwards is a no-op."""
+        try:
+            self.flush()
+        finally:
+            with self._cond:
+                self._stop = True
+                self._cond.notify_all()
+            self._thread.join(timeout=30)
+
+    def abort(self) -> None:
+        """Best-effort shutdown for cleanup paths: drop any pending
+        snapshot, stop the worker, never raise — exception unwinds must
+        not leak the thread (the success path calls ``close``, which DOES
+        surface errors, first)."""
+        with self._cond:
+            self._pending = None
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10)
+
+
+class PreemptionGuard:
+    """SIGTERM → save-and-exit at the next chunk boundary.
+
+    Installing replaces the handler (previous one restored on
+    ``uninstall``/context exit); the handler only sets a flag — all real
+    work (submit, flush, exit) happens on the driver thread at a chunk
+    boundary, where the state is a consistent cut. Drivers exit with
+    ``EXIT_PREEMPTED`` so wrappers can distinguish a clean preemption
+    save from a failure. Signal handlers only install from the main
+    thread; elsewhere the guard degrades to an inert flag (``installed``
+    False) rather than raising — a library must not fight the host
+    process over signal ownership."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._old: dict = {}
+        self.installed = False
+
+    def install(self) -> "PreemptionGuard":
+        for sig in self._signals:
+            try:
+                self._old[sig] = signal.signal(sig, self._on_signal)
+                self.installed = True
+            except (ValueError, OSError):  # non-main thread / exotic host
+                pass
+        return self
+
+    def uninstall(self) -> None:
+        for sig, old in self._old.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old.clear()
+        self.installed = False
+
+    def _on_signal(self, signum, frame) -> None:
+        self._event.set()
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def save_and_exit(self, checkpointer: AsyncCheckpointer, state,
+                      mbuf=None, meta: Optional[dict] = None) -> None:
+        """The boundary action: submit the current cut, wait until it is
+        durable, announce, exit. Never returns."""
+        checkpointer.submit(state, mbuf=mbuf, meta=meta)
+        checkpointer.flush()
+        tick = ck.peek_checkpoint_t(checkpointer.path)
+        print(f"# preempted: checkpoint saved at t={tick} ms -> "
+              f"{checkpointer.path}", file=sys.stderr)
+        sys.stderr.flush()
+        sys.exit(EXIT_PREEMPTED)

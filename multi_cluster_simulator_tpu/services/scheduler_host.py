@@ -154,7 +154,11 @@ class SchedulerService(Service):
         from multi_cluster_simulator_tpu.core.checkpoint import (
             load_extra, load_state,
         )
-        self.state = load_state(self.checkpoint_path, self.state)
+        # cfg engages the v2 header digest: a checkpoint from a
+        # differently-configured scheduler is refused with the field
+        # named (and the caller's start-fresh fallback engages)
+        self.state = load_state(self.checkpoint_path, self.state,
+                                cfg=self.cfg)
         # the host arrival ring died with the old process; rebase the
         # device cursor to the now-empty ring
         consumed = int(np.asarray(self.state.arr_ptr)[0])
@@ -474,7 +478,8 @@ class SchedulerService(Service):
                             int(ring["mem"][i]), int(ring["dur"][i]),
                             delay_policy])
         save_state(state, self.checkpoint_path,
-                   extra={"owner_urls": owner_urls, "pending": pending})
+                   extra={"owner_urls": owner_urls, "pending": pending},
+                   cfg=self.cfg)
 
     def _warmup(self) -> None:
         """Compile the tick and the handler-path host ops before serving

@@ -286,6 +286,9 @@ class ServingScheduler(Service):
         # watermark at every checkpoint. guarded by _stage_lock.
         import collections as _collections
         self._wal_tick_off: _collections.deque = _collections.deque()
+        self._wal_rotations = 0  # lifetime WAL compactions: once > 0,
+        # the log is NOT full history and WAL-alone recovery would
+        # silently lose the compacted prefix (_open_wal refuses)
         self._wal_parked = False  # any parked record ever logged disables
         #                           the offset/rotation optimizations
         # one compiled probe for the whole snapshot's scalar/vector reads:
@@ -685,16 +688,53 @@ class ServingScheduler(Service):
         SEEKS to the live suffix instead of decoding the log's whole
         lifetime; any mismatch falls back to the full scan — offsets are
         an optimization, the replay watermark filter is the truth."""
-        from multi_cluster_simulator_tpu.core.checkpoint import load_extra
+        from multi_cluster_simulator_tpu.core import checkpoint as ckio
         from multi_cluster_simulator_tpu.services import wal as walmod
         extra: dict = {}
         if self.checkpoint_path and os.path.exists(self.checkpoint_path):
             try:
-                extra = load_extra(self.checkpoint_path)
+                # full header validation BEFORE any of it is trusted: an
+                # unreadable, old-format, or wrong-config checkpoint must
+                # not seed the WAL offset seek below (replaying a seeked
+                # SUFFIX onto a fresh state would lose the prefix) — a
+                # rejection here degrades to the coherent WAL-alone
+                # full-history path, loudly, never a crash loop
+                header = ckio._read_header(self.checkpoint_path)
+                ckio._check_header(header, self.checkpoint_path,
+                                   cfg=self.cfg)
+                extra = header.get("extra") or {}
             except Exception as e:
+                # WAL-alone is only a legal fallback when the log is FULL
+                # history. A rotation compacted the dispatched prefix
+                # away, so replaying the remainder onto a fresh state
+                # would silently lose acked work — refuse loudly instead.
+                # Evidence (best-effort from the raw header, readable
+                # even when validation failed): a recorded rotation
+                # count, or the log's current generation differing from
+                # the one the checkpoint saw (rotate stamps a fresh one).
+                evidence: dict = {}
+                try:
+                    evidence = ckio._read_header(
+                        self.checkpoint_path).get("extra") or {}
+                except Exception:
+                    pass
+                rotated = int(evidence.get("wal_rotations", 0) or 0) > 0
+                if (not rotated and evidence.get("wal_gen") is not None
+                        and self.wal_path and os.path.exists(self.wal_path)):
+                    cur_gen = walmod.read_header(self.wal_path)
+                    rotated = (cur_gen is not None
+                               and cur_gen != evidence.get("wal_gen"))
+                if rotated:
+                    raise RuntimeError(
+                        f"checkpoint {self.checkpoint_path} is not "
+                        f"restorable ({e!r}) and the WAL has been "
+                        "compacted (rotation evidence in the header): "
+                        "WAL-alone recovery would silently lose the "
+                        "dispatched prefix — restore a compatible build, "
+                        "or delete BOTH files to start fresh") from e
                 self.logger.error(
-                    "checkpoint %s unreadable (%r); recovering from the "
-                    "WAL alone", self.checkpoint_path, e)
+                    "checkpoint %s not restorable (%r); recovering from "
+                    "the WAL alone", self.checkpoint_path, e)
                 extra = {"_ckpt_unreadable": True}
         start = gen = None
         if recover and not extra.get("wal_parked"):
@@ -749,15 +789,47 @@ class ServingScheduler(Service):
         parked_skip = 0
         if (self.checkpoint_path and os.path.exists(self.checkpoint_path)
                 and not extra.get("_ckpt_unreadable")):
-            self._state = load_state(self.checkpoint_path, self._state)
-            # donation discipline: loaded leaves are distinct host arrays,
-            # but clone anyway so no two leaves can alias one buffer
-            self._state = jax.tree.map(jnp.copy, self._state)
-            t0_ticks = int(extra.get("ticks_dispatched", 0))
-            parked_skip = int(extra.get("parked_applied", 0))
-            self.ticks_dispatched = t0_ticks
-            self.dispatches = int(extra.get("dispatches", 0))
-            self._parked_applied = parked_skip
+            # cfg verifies the v2 header's config digest: a checkpoint
+            # from a differently-configured (or older-format) server must
+            # never replay the WAL onto the wrong-shaped world. _open_wal
+            # pre-validated the header, so a failure HERE (payload-level:
+            # torn msgpack, leaf mismatch) is a corner — it degrades to
+            # the WAL-alone fresh-state path like scheduler_host's
+            # start-fresh fallback, UNLESS the records were offset-seeked
+            # to a suffix (replaying a suffix onto a fresh state would
+            # silently lose the prefix — refuse loudly instead).
+            try:
+                loaded = load_state(self.checkpoint_path, self._state,
+                                    cfg=self.cfg)
+            except (OSError, ValueError) as e:
+                seeked = (extra.get("wal_offset") is not None
+                          and not extra.get("wal_parked"))
+                rotated = int(extra.get("wal_rotations", 0) or 0) > 0
+                if seeked or rotated:
+                    why = ("the WAL was offset-seeked to the "
+                           "post-watermark suffix" if seeked else
+                           "the WAL has been compacted by rotation")
+                    raise RuntimeError(
+                        f"checkpoint {self.checkpoint_path} failed to "
+                        f"load ({e!r}) after its header validated, and "
+                        f"{why} — cannot fall back to WAL-alone recovery "
+                        "without losing the prefix; restore a compatible "
+                        "build or delete BOTH files to start fresh"
+                    ) from e
+                self.logger.error(
+                    "checkpoint %s not restorable (%r); recovering from "
+                    "the WAL alone", self.checkpoint_path, e)
+            else:
+                # donation discipline: loaded leaves are distinct host
+                # arrays, but clone anyway so no two leaves can alias one
+                # buffer
+                self._state = jax.tree.map(jnp.copy, loaded)
+                t0_ticks = int(extra.get("ticks_dispatched", 0))
+                parked_skip = int(extra.get("parked_applied", 0))
+                self.ticks_dispatched = t0_ticks
+                self.dispatches = int(extra.get("dispatches", 0))
+                self._parked_applied = parked_skip
+                self._wal_rotations = int(extra.get("wal_rotations", 0))
         with self._stage_lock:
             self._stage_t = t0_ticks
         self._refresh_snapshot()
@@ -831,12 +903,15 @@ class ServingScheduler(Service):
                 if (not self._wal_parked
                         and start - HEADER_LEN > self.wal_rotate_bytes):
                     delta = self._wal.rotate(start)
+                    self._wal_rotations += 1
                     self._wal_tick_off = type(self._wal_tick_off)(
                         (tk, off - delta) for tk, off in self._wal_tick_off)
                     start -= delta
                 extra.update(wal_offset=start, wal_gen=self._wal.generation,
-                             wal_parked=self._wal_parked)
-        save_state(self._state, self.checkpoint_path, extra=extra)
+                             wal_parked=self._wal_parked,
+                             wal_rotations=self._wal_rotations)
+        save_state(self._state, self.checkpoint_path, extra=extra,
+                   cfg=self.cfg)
 
     # ------------------------------------------------------------------
     # dispatch (single owner: the drive thread or the deterministic driver)

@@ -63,6 +63,73 @@ def test_checkpoint_rejects_other_config(tmp_path):
         load_state(path, template)
 
 
+def test_header_names_differing_config_field(tmp_path):
+    """v2 header hardening: a wrong-config resume fails fast NAMING the
+    differing field — including fields leaf shapes can't see (the old
+    advisory header let a same-shape config mismatch load silently)."""
+    path = str(tmp_path / "ckpt.bin")
+    state0, _ = _setup()
+    save_state(state0, path, cfg=CFG)
+    # max_ingest_per_tick changes NO leaf shape — only the digest catches it
+    other = dataclasses.replace(CFG, max_ingest_per_tick=8)
+    template = init_state(other, [uniform_cluster(c + 1, 5) for c in range(8)])
+    with pytest.raises(ValueError, match="max_ingest_per_tick"):
+        load_state(path, template, cfg=other)
+    # and the matching config loads clean
+    ok = load_state(path, init_state(CFG, [uniform_cluster(c + 1, 5)
+                                           for c in range(8)]), cfg=CFG)
+    assert int(np.asarray(ok.t)) == 0
+
+
+def test_header_rejects_plan_mismatch(tmp_path):
+    """A stale compact plan satisfies the leaf shape/dtype check (same
+    narrow dtypes, different audited bounds) — only the plan record in the
+    header can reject it, naming the differing field."""
+    from multi_cluster_simulator_tpu.core.compact import derive_plan
+
+    path = str(tmp_path / "ckpt.bin")
+    state0, arrivals = _setup()
+    plan = derive_plan(CFG, [uniform_cluster(c + 1, 5) for c in range(8)],
+                       arrivals)
+    save_state(state0, path, cfg=CFG, plan=plan)
+    # wide-vs-compact conflation is the loud case
+    with pytest.raises(ValueError, match="compact storage plan"):
+        load_state(path, state0, cfg=CFG, plan=None)
+    # and a plan whose derivation differs rejects even when dtypes agree
+    stale = dataclasses.replace(plan, node="int8")
+    with pytest.raises(ValueError, match="node"):
+        load_state(path, state0, cfg=CFG, plan=stale)
+
+
+def test_header_rejects_policy_digest_mismatch(tmp_path):
+    from multi_cluster_simulator_tpu.core.preempt import policy_digest_for
+
+    path = str(tmp_path / "ckpt.bin")
+    state0, _ = _setup()
+    save_state(state0, path, cfg=CFG, policy_digest=policy_digest_for(CFG))
+    with pytest.raises(ValueError, match="policy params"):
+        load_state(path, state0, cfg=CFG, policy_digest="0000deadbeef")
+
+
+def test_rejects_v1_format(tmp_path):
+    """The pre-digest v1 format (advisory header) is refused outright —
+    a stale checkpoint must be re-created, never trusted on shapes."""
+    import json as _json
+    import struct as _struct
+
+    from multi_cluster_simulator_tpu.core import checkpoint as ckio
+
+    path = str(tmp_path / "v1.bin")
+    hdr = _json.dumps({"t": 0, "extra": {}}).encode()  # no "v": version 1
+    with open(path, "wb") as f:
+        f.write(ckio._MAGIC)
+        f.write(_struct.pack("<I", len(hdr)))
+        f.write(hdr)
+    state0, _ = _setup()
+    with pytest.raises(ValueError, match="format v1"):
+        load_state(path, state0)
+
+
 def test_checkpoint_rejects_garbage(tmp_path):
     p = tmp_path / "junk.bin"
     p.write_bytes(b"definitely not a checkpoint")
@@ -94,6 +161,14 @@ def test_bench_resume_flag(tmp_path):
     assert first.returncode == 0, first.stderr[-2000:]
     assert os.path.exists(ck + ".headline")  # per-config checkpoint file
     line = json.loads(first.stdout.strip().splitlines()[-1])
+    # the async-checkpointing overhead A/B lands in the detail (the
+    # acceptance instrument for retiring the old blocking per-chunk sync)
+    detail = next(json.loads(ln[len("# detail: "):])
+                  for ln in first.stderr.splitlines()
+                  if ln.startswith("# detail: "))
+    assert detail["checkpoint"]["async"] is True
+    assert detail["checkpoint"]["writes"] >= 1
+    assert "overhead_frac" in detail["checkpoint"]
     # resume from the completed checkpoint: nothing left to simulate, but
     # the final state (and its placed_total) is all there
     second = run_bench("--resume")

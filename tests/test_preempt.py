@@ -1,0 +1,498 @@
+"""The preemption plane (core/preempt.py): a batch run killed at ANY chunk
+boundary and resumed from its RunCheckpoint reaches a final state
+bit-identical to the uninterrupted run — composed with the compact layout,
+event-compressed time, the fault plane, and the device mesh — the async
+checkpointer's snapshots survive donation, torn writes never eat the
+previous checkpoint, and the SIGTERM guard saves-and-exits cleanly.
+tools/chaos.py --batch is the subprocess-level kill -9 proof; these are
+the library-level pins."""
+
+import dataclasses
+import json
+import os
+import signal
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multi_cluster_simulator_tpu.config import (
+    FaultConfig, PolicyKind, SimConfig,
+)
+from multi_cluster_simulator_tpu.core import preempt
+from multi_cluster_simulator_tpu.core.compact import derive_plan, to_wide
+from multi_cluster_simulator_tpu.core.engine import (
+    Engine, pack_arrivals_by_tick,
+)
+from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.workload.traces import (
+    bursty_stream, uniform_stream,
+)
+
+C = 8
+T = 48
+CHUNK = 12
+
+_CHURN_TRACE = [(c, c % 5, 9_000, 14_000) for c in range(C)] + \
+    [(0, 1, 26_000, 31_000), (3, 2, 26_000, 26_000)]
+
+
+def _cfg(faults=False):
+    cfg = SimConfig(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                    queue_capacity=32, max_running=64, max_arrivals=40,
+                    max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0)
+    if faults:
+        cfg = dataclasses.replace(cfg, faults=FaultConfig(
+            enabled=True, mode="trace", max_retries=8, max_events=4))
+    return cfg
+
+
+def _specs():
+    return [uniform_cluster(c + 1, 5) for c in range(C)]
+
+
+def _stream(seed=3):
+    return uniform_stream(C, 40, (T - 8) * 1_000, max_cores=8, max_mem=6_000,
+                          max_dur_ms=12_000, seed=seed)
+
+
+def _tree_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def _state0(cfg, plan=None):
+    return init_state(cfg, _specs(), plan=plan,
+                      fault_events=_CHURN_TRACE if cfg.faults.enabled
+                      else None)
+
+
+def _chunks(ta):
+    return [jax.tree.map(lambda x: x[o:o + CHUNK], ta)
+            for o in range(0, T, CHUNK)]
+
+
+@pytest.mark.parametrize("compact,faults", [
+    (False, False), (True, False), (False, True), (True, True),
+], ids=["wide", "compact", "faults", "compact+faults"])
+def test_resume_every_boundary_bit_identical(tmp_path, compact, faults):
+    """Save/load at EVERY chunk boundary == uninterrupted, across the
+    layout x fault-plane matrix. The fault-plane cells prove the churn
+    clocks (interval tables, cursors, down_until, retry counters) ride
+    the checkpoint: the post-cut outages replay identically."""
+    cfg = _cfg(faults)
+    arrivals = _stream()
+    plan = derive_plan(cfg, _specs(), arrivals) if compact else None
+    ta = pack_arrivals_by_tick(arrivals, T, cfg.tick_ms)
+    chunks = _chunks(ta)
+    fn = Engine(cfg).run_jit()
+    pdig = preempt.policy_digest_for(cfg)
+
+    s = _state0(cfg, plan)
+    for ch in chunks:
+        s = fn(s, ch, CHUNK)
+    straight = s
+    if faults:
+        kills = int(np.asarray(straight.faults.kills).sum())
+        assert kills > 0, "churn never engaged — the fault cells are vacuous"
+
+    for b in range(1, len(chunks)):
+        path = str(tmp_path / f"b{b}.ckpt")
+        s = _state0(cfg, plan)
+        for ch in chunks[:b]:
+            s = fn(s, ch, CHUNK)
+        preempt.save_run(path, s, meta={"chunk_idx": b,
+                                        "dense_ticks": b * CHUNK},
+                         cfg=cfg, plan=plan, policy_digest=pdig,
+                         tick_ms=cfg.tick_ms)
+        del s  # the "kill": nothing survives but the file
+        rc = preempt.load_run(path, _state0(cfg, plan), cfg=cfg, plan=plan,
+                              policy_digest=pdig)
+        assert rc.tick == b * CHUNK
+        s = rc.state
+        if faults:
+            # churn clocks round-trip bitwise before any further tick runs
+            mid = _state0(cfg, plan)
+            for ch in chunks[:b]:
+                mid = fn(mid, ch, CHUNK)
+            assert _tree_equal(s.faults, mid.faults)
+        for ch in chunks[b:]:
+            s = fn(s, ch, CHUNK)
+        assert _tree_equal(to_wide(s), to_wide(straight)), (
+            f"resume at boundary {b} diverged "
+            f"(compact={compact}, faults={faults})")
+
+
+def test_resume_mid_leap_region_compressed(tmp_path):
+    """A checkpoint cut landing inside a quiescent valley (the region the
+    leap driver jumps): the resumed compressed run is bit-identical AND
+    the ticks_executed cursor telescopes to the uninterrupted total."""
+    cfg = _cfg()
+    bursts, interval = 2, 30_000
+    arrivals = bursty_stream(C, bursts, 8, interval, 6_000, max_cores=8,
+                             max_mem=6_000, max_dur_ms=10_000, seed=5)
+    n_ticks = bursts * interval // cfg.tick_ms + 10  # 70
+    sizes = [20, 20, 30]  # boundary at tick 20: mid-valley by construction
+    ta = pack_arrivals_by_tick(arrivals, n_ticks, cfg.tick_ms)
+    offs = np.cumsum([0] + sizes)
+    chunks = [jax.tree.map(lambda x, o=o, n=n: x[o:o + n], ta)
+              for o, n in zip(offs[:-1], sizes)]
+    eng = Engine(cfg)
+    fns = {n: eng.run_compressed_jit() for n in set(sizes)}
+
+    s = init_state(cfg, _specs())
+    executed = 0
+    for ch, n in zip(chunks, sizes):
+        s, stats = fns[n](s, ch, n)
+        executed += int(np.asarray(stats.ticks_executed))
+    straight, straight_exec = s, executed
+    assert straight_exec < n_ticks, "compression never engaged"
+
+    path = str(tmp_path / "leap.ckpt")
+    s = init_state(cfg, _specs())
+    s, stats = fns[20](s, chunks[0], 20)
+    preempt.save_run(path, s,
+                     meta={"chunk_idx": 1, "leap_stats": [stats]},
+                     cfg=cfg, plan=None, tick_ms=cfg.tick_ms)
+    rc = preempt.load_run(path, init_state(cfg, _specs()), cfg=cfg,
+                          plan=None)
+    s, executed = rc.state, int(rc.meta["ticks_executed"])
+    for ch, n in zip(chunks[1:], sizes[1:]):
+        s, stats = fns[n](s, ch, n)
+        executed += int(np.asarray(stats.ticks_executed))
+    assert _tree_equal(s, straight)
+    assert executed == straight_exec, (
+        "the resumed ticks_executed cursor does not telescope to the "
+        "uninterrupted total")
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_mesh_resume_bit_identical(tmp_path, n_dev):
+    """The sharded cut: save from a mesh run at a chunk boundary (the
+    per-shard state gathers to global host leaves), restore into a host
+    template, re-shard via the pytree-prefix specs, finish — final state
+    bit-identical to the single-device uninterrupted run. Composed with
+    the compact plan and the fault plane."""
+    from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
+
+    if len(jax.devices()) < n_dev:
+        pytest.skip("needs the 8-virtual-device CPU mesh (conftest)")
+    cfg = _cfg(faults=True)
+    arrivals = _stream(seed=9)
+    plan = derive_plan(cfg, _specs(), arrivals)
+    ta = pack_arrivals_by_tick(arrivals, T, cfg.tick_ms)
+    ref = Engine(cfg).run_jit()(_state0(cfg, plan), ta, T)
+
+    sh = ShardedEngine(cfg, make_mesh(n_dev))
+    mid_fn = sh.run_fn(T // 2, tick_indexed=True)
+    mid = mid_fn(sh.shard_state(_state0(cfg, plan)),
+                 sh.shard_arrivals(jax.tree.map(lambda x: x[: T // 2], ta)))
+    path = str(tmp_path / "mesh.ckpt")
+    preempt.save_run(path, mid, cfg=cfg, plan=plan, tick_ms=cfg.tick_ms)
+    del mid
+    rc = preempt.load_run(path, _state0(cfg, plan), cfg=cfg, plan=plan)
+    # restore re-shards through the same pytree-prefix placement
+    s = sh.shard_state(rc.state)
+    fin = sh.run_fn(T - T // 2, tick_indexed=True)(
+        s, sh.shard_arrivals(jax.tree.map(lambda x: x[T // 2:], ta)))
+    assert _tree_equal(fin, ref), (
+        f"{n_dev}-device mesh resume diverged from the single-device "
+        "uninterrupted run")
+
+
+def test_obs_metrics_carry_across_resume(tmp_path):
+    """The MetricsBuffer rides the RunCheckpoint: a resumed run's final
+    harvest equals the uninterrupted run's (the whole-run telemetry spans
+    the cut)."""
+    from multi_cluster_simulator_tpu.obs import device as obs_dev
+
+    cfg = _cfg()
+    arrivals = _stream(seed=13)
+    ta = pack_arrivals_by_tick(arrivals, T, cfg.tick_ms)
+    chunks = _chunks(ta)
+    eng = Engine(cfg)
+    fn = eng.run_jit()
+
+    s, mb = init_state(cfg, _specs()), obs_dev.metrics_init(
+        init_state(cfg, _specs()))
+    for ch in chunks:
+        s, mb = fn(s, ch, CHUNK, None, mb)
+    straight_h = obs_dev.harvest(mb)
+
+    path = str(tmp_path / "obs.ckpt")
+    s = init_state(cfg, _specs())
+    mb = obs_dev.metrics_init(s)
+    for ch in chunks[:2]:
+        s, mb = fn(s, ch, CHUNK, None, mb)
+    preempt.save_run(path, s, mbuf=mb, meta={"chunk_idx": 2}, cfg=cfg,
+                     tick_ms=cfg.tick_ms)
+    rc = preempt.load_run(path, init_state(cfg, _specs()), cfg=cfg)
+    assert rc.mbuf is not None, "the buffer did not ride the checkpoint"
+    s, mb = rc.state, rc.mbuf
+    for ch in chunks[2:]:
+        s, mb = fn(s, ch, CHUNK, None, mb)
+    assert obs_dev.harvest(mb) == straight_h
+
+
+def test_async_snapshot_survives_donation(tmp_path):
+    """The async-correctness pin: submit() snapshots the device refs, so
+    the very next DONATING dispatch (which invalidates the submitted
+    buffers) cannot corrupt the checkpoint."""
+    cfg = _cfg()
+    arrivals = _stream(seed=17)
+    ta = pack_arrivals_by_tick(arrivals, T, cfg.tick_ms)
+    eng = Engine(cfg)
+    dfn = eng.run_jit(donate=True)
+    path = str(tmp_path / "async.ckpt")
+    ck = preempt.AsyncCheckpointer(path, cfg=cfg, tick_ms=cfg.tick_ms)
+    # the driver discipline: clone before the donation chain (init_state
+    # shares zero-buffers across leaves; donating it raw is illegal)
+    s = dfn(jax.tree.map(jnp.copy, init_state(cfg, _specs())), ta, 24)
+    ck.submit(s, meta={"chunk_idx": 1, "dense_ticks": 24})
+    s2 = dfn(s, ta, 24)  # donates s's buffers immediately
+    ck.flush()
+    jax.block_until_ready(s2)
+    ck.close()
+    ref = Engine(cfg).run_jit()(init_state(cfg, _specs()), ta, 24)
+    rc = preempt.load_run(path, init_state(cfg, _specs()), cfg=cfg)
+    assert _tree_equal(rc.state, ref)
+
+
+def test_async_latest_wins_and_error_surfaces(tmp_path):
+    """A slow disk never queues snapshots without bound (latest-wins,
+    skipped counted) and a worker failure re-raises at flush — never a
+    silently missing checkpoint."""
+    cfg = _cfg()
+    s = init_state(cfg, _specs())
+    gate = threading.Event()
+    wrote = []
+
+    def slow_save(path, state, **kw):
+        gate.wait(timeout=30)
+        wrote.append(int(np.asarray(state.t)))
+        preempt.save_run(path, state, **kw)
+
+    path = str(tmp_path / "lw.ckpt")
+    ck = preempt.AsyncCheckpointer(path, cfg=cfg, save_fn=slow_save)
+    ck.submit(s.replace(t=jnp.int32(1000)))
+    ck.submit(s.replace(t=jnp.int32(2000)))  # replaces any waiting snapshot
+    ck.submit(s.replace(t=jnp.int32(3000)))
+    gate.set()
+    ck.flush()
+    assert wrote[-1] == 3000, "the final submit must always be written"
+    assert ck.writes + ck.skipped == 3 and ck.skipped >= 1
+    ck.close()
+
+    def broken_save(path, state, **kw):
+        raise OSError("disk on fire")
+
+    ck2 = preempt.AsyncCheckpointer(str(tmp_path / "err.ckpt"), cfg=cfg,
+                                    save_fn=broken_save)
+    ck2.submit(s)
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        ck2.flush()
+
+
+def test_torn_write_preserves_previous_checkpoint(tmp_path, monkeypatch):
+    """A kill (or failure) mid-serialize must leave the PREVIOUS
+    checkpoint intact: writes go to .tmp and only a complete file is
+    renamed over the target."""
+    from multi_cluster_simulator_tpu.core import checkpoint as ckio
+
+    cfg = _cfg()
+    s = init_state(cfg, _specs())
+    path = str(tmp_path / "torn.ckpt")
+    preempt.save_run(path, s, cfg=cfg)
+    good = open(path, "rb").read()
+
+    real_write = ckio._write
+
+    def dying_write(p, header, payload):
+        # simulate the kill landing mid-write: the tmp file gets a torn
+        # prefix and the process "dies" before the rename
+        with open(p + ".tmp", "wb") as f:
+            f.write(payload[: max(len(payload) // 2, 1)])
+        raise KeyboardInterrupt("kill -9 during serialize")
+
+    monkeypatch.setattr(ckio, "_write", dying_write)
+    with pytest.raises(KeyboardInterrupt):
+        preempt.save_run(path, s.replace(t=jnp.int32(999)), cfg=cfg)
+    monkeypatch.setattr(ckio, "_write", real_write)
+    assert open(path, "rb").read() == good, (
+        "a torn write corrupted the previous checkpoint")
+    rc = preempt.load_run(path, init_state(cfg, _specs()), cfg=cfg)
+    assert int(np.asarray(rc.state.t)) == 0
+
+
+def test_preemption_guard_sigterm(tmp_path):
+    """SIGTERM sets the flag (no work in the handler), uninstall restores
+    the previous handler, and save_and_exit writes a durable checkpoint
+    then raises SystemExit(EXIT_PREEMPTED)."""
+    prev = signal.getsignal(signal.SIGTERM)
+    guard = preempt.PreemptionGuard().install()
+    try:
+        assert not guard.triggered
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = __import__("time").time() + 2.0
+        while not guard.triggered and __import__("time").time() < deadline:
+            pass  # the handler runs at a bytecode boundary
+        assert guard.triggered
+    finally:
+        guard.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+    cfg = _cfg()
+    s = init_state(cfg, _specs())
+    path = str(tmp_path / "term.ckpt")
+    ck = preempt.AsyncCheckpointer(path, cfg=cfg)
+    with pytest.raises(SystemExit) as e:
+        preempt.PreemptionGuard().save_and_exit(ck, s, meta={"chunk_idx": 3})
+    assert e.value.code == preempt.EXIT_PREEMPTED
+    rc = preempt.load_run(path, init_state(cfg, _specs()), cfg=cfg)
+    assert rc.meta["chunk_idx"] == 3
+
+
+def test_generative_churn_clocks_roundtrip(tmp_path):
+    """Generative-mode fault streams (counter-based next_fail/down_until
+    clocks + per-cluster keys) survive the checkpoint cut: the resumed
+    run replays the exact remaining churn schedule."""
+    cfg = dataclasses.replace(_cfg(), faults=FaultConfig(
+        enabled=True, mode="generative", mttf_ms=15_000, mttr_ms=3_000,
+        seed=21, max_retries=8))
+    arrivals = _stream(seed=23)
+    ta = pack_arrivals_by_tick(arrivals, T, cfg.tick_ms)
+    chunks = _chunks(ta)
+    fn = Engine(cfg).run_jit()
+    s = init_state(cfg, _specs())
+    for ch in chunks:
+        s = fn(s, ch, CHUNK)
+    straight = s
+    assert int(np.asarray(straight.faults.kills).sum()) > 0
+
+    path = str(tmp_path / "gen.ckpt")
+    s = init_state(cfg, _specs())
+    s = fn(s, chunks[0], CHUNK)
+    preempt.save_run(path, s, cfg=cfg, tick_ms=cfg.tick_ms)
+    rc = preempt.load_run(path, init_state(cfg, _specs()), cfg=cfg)
+    s = rc.state
+    for ch in chunks[1:]:
+        s = fn(s, ch, CHUNK)
+    assert _tree_equal(s, straight)
+
+
+def test_train_env_demo_resume_bit_identical(tmp_path):
+    """ClusterEnv episode checkpointing (tools/train_env_demo.py): a
+    killed ES training run resumes bit-identically — same per-iteration
+    returns, same head — with per-env generative fault streams enabled,
+    proving faults.reseed's per-env churn state survives the round-trip."""
+    from tools.train_env_demo import train
+
+    fc = FaultConfig(enabled=True, mode="generative", mttf_ms=8_000,
+                     mttr_ms=2_000, seed=5)
+    ck = str(tmp_path / "train.ckpt")
+    kw = dict(iters=3, n_envs=4, n_clusters=2, episode_ticks=5, seed=3,
+              faults=fc)
+    full = train(**kw)
+    train(**{**kw, "iters": 1}, checkpoint=ck)
+    res = train(**kw, checkpoint=ck, resume=True)
+    assert res["mean_return_per_iter"] == full["mean_return_per_iter"]
+    assert np.array_equal(res["W"], full["W"])
+    # the fault streams in the saved reset batch round-trip bitwise
+    from multi_cluster_simulator_tpu.core import checkpoint as ckio
+    assert ckio.load_extra(ck)["iter"] == 3
+
+
+def test_serving_degrades_on_rejected_checkpoint(tmp_path):
+    """A serving restart with a stale-FORMAT (v1) checkpoint must not
+    crash-loop: the header rejection degrades to WAL-alone full-history
+    recovery (the missing-checkpoint path), loudly, and the recovered
+    state still equals the uninterrupted reference."""
+    import struct as _struct
+
+    from multi_cluster_simulator_tpu.core import checkpoint as ckio
+    from multi_cluster_simulator_tpu.services.serving import ServingScheduler
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+
+    cfg = SimConfig(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                    queue_capacity=64, max_running=128, max_arrivals=32,
+                    max_ingest_per_tick=16, max_nodes=5, max_virtual_nodes=0)
+    specs = [uniform_cluster(c + 1, 5) for c in range(2)]
+
+    def serve(name, sub, wal=True, ckpt=True):
+        d = tmp_path / sub
+        d.mkdir(exist_ok=True)
+        return ServingScheduler(
+            name, specs, cfg, pacer=False, window=4, warm_k=(4,), k_cap=32,
+            max_staged=10 ** 6,
+            wal_path=str(d / "serve.wal") if wal else None,
+            checkpoint_path=str(d / "serve.ckpt") if ckpt else None,
+            checkpoint_every=2)
+
+    def feed(s, ticks, dispatch_every=None, jid0=1):
+        jid = jid0
+        for t in range(ticks):
+            for _ in range(2):
+                assert s.submit_direct(c=jid % 2, jid=jid, cores=1,
+                                       mem=100, dur_ms=2_000)
+                jid += 1
+            s.seal_tick()
+            if dispatch_every and (t + 1) % dispatch_every == 0:
+                s.dispatch_sealed()
+        return jid
+
+    s1 = serve("pre-upgrade", "a")
+    feed(s1, 8, dispatch_every=4)
+    # "kill -9", then downgrade the checkpoint to the v1 format (header
+    # without a version field — the pre-digest era)
+    ck_path = str(tmp_path / "a" / "serve.ckpt")
+    header, payload = ckio._read(ck_path)
+    header.pop("v"), header.pop("config", None)
+    hdr = json.dumps(header).encode()
+    with open(ck_path, "wb") as f:
+        f.write(ckio._MAGIC)
+        f.write(_struct.pack("<I", len(hdr)))
+        f.write(hdr)
+        f.write(payload)
+
+    s2 = serve("post-upgrade", "a")  # must NOT raise
+    assert s2.recovered_jobs == 16  # WAL-alone: the FULL history replayed
+    s2.dispatch_sealed()
+    while s2._staged_ticks() < 16:
+        s2.seal_tick()
+    s2.dispatch_sealed()
+    rec = s2.state_host()
+
+    ref = serve("ref", "b", wal=False, ckpt=False)
+    feed(ref, 8)
+    while ref._staged_ticks() < 16:
+        ref.seal_tick()
+    ref.dispatch_sealed()
+    assert _tree_equal(rec, ref.state_host())
+    assert all(v == 0 for v in total_drops(rec).values())
+
+
+def test_tournament_resume_cells(tmp_path):
+    """tools/tournament.py --resume: verified (policy, seed) cells persist
+    with the grid digest; a rerun re-runs only missing variants and the
+    merged rows equal a from-scratch sweep; a changed grid fails fast."""
+    from tools.tournament import run_tournament
+
+    rp = str(tmp_path / "cells.json")
+    kw = dict(policies=("fifo", "delay"), n_seeds=2, C=4, jobs_per=16,
+              horizon_ms=20_000, drain_ticks=20)
+    full = run_tournament(**kw)
+    run_tournament(**kw, resume_path=rp)
+    import json
+    cache = json.load(open(rp))
+    del cache["completed"]["delay"]  # simulate a kill after variant 1
+    json.dump(cache, open(rp, "w"))
+    res = run_tournament(**kw, resume_path=rp)
+    assert res["resumed_variants"] == ["fifo"]
+    strip = [{k: v for k, v in r.items() if k != "resumed"}
+             for r in res["rows"]]
+    assert strip == full["rows"]
+    with pytest.raises(ValueError, match="different grid"):
+        run_tournament(**{**kw, "jobs_per": 20}, resume_path=rp)

@@ -1,7 +1,26 @@
 #!/usr/bin/env python
-"""Chaos harness for the serving tier's crash-recovery contract.
+"""Chaos harness for the crash/preemption contracts — serving AND batch.
 
-Kill -9s a live ``ServingScheduler`` child at randomized points under real
+Two modes:
+
+- default (serving): kill -9 a live ``ServingScheduler`` under real HTTP
+  traffic and assert WAL + checkpoint recovery (the PR-13 gate; details
+  below).
+- ``--batch`` (the preemption plane, core/preempt.py): kill -9 a
+  *resumable batch run* — a ``bench.py --config churn_bursts`` child with
+  compact state, event-compressed time, and the fault plane composed,
+  checkpointing asynchronously at every chunk boundary — at randomized
+  chunk boundaries N times, resume each time, and assert the final
+  checkpointed state is BIT-IDENTICAL to an uninterrupted reference run
+  (leaf for leaf, and the cumulative ``ticks_executed`` compression
+  cursor telescopes to the same total). One cycle uses SIGTERM instead:
+  the child must save-and-exit cleanly at the next boundary with exit
+  code 75 (``EXIT_PREEMPTED``). Runs the matrix on 1 device and the
+  8-virtual-device mesh (quick: 1 device + a 2-device sharded resume
+  A/B cell).
+
+Serving mode in detail: kill -9s a live ``ServingScheduler`` child at
+randomized points under real
 HTTP traffic, restarts it (restore checkpoint + replay WAL suffix —
 services/serving.py ``_recover``), and after >= ``--cycles`` crash/restart
 rounds asserts the durability story the 200-ack promises:
@@ -26,12 +45,13 @@ file, so traffic keeps flowing across restarts; 503 quotes honor
 
 Usage:
   python tools/chaos.py [--quick] [--cycles N] [--jobs N] [--out PATH]
+  python tools/chaos.py --batch [--quick] [--cycles N] [--out PATH]
   python tools/chaos.py --serve --dir D --url-file F   (child mode)
 
-CI runs ``--quick`` (2 cycles); the full run is >= 5 cycles (the
-acceptance bar). Everything is pinned to host CPU — the deployment shape
-measured is an engine colocated with its host (the bench `serving`
-pattern).
+CI runs ``--quick`` (2 cycles) for both modes; the full runs are >= 5
+cycles (the acceptance bar). Everything is pinned to host CPU — the
+deployment shape measured is an engine colocated with its host (the
+bench `serving` pattern).
 """
 
 from __future__ import annotations
@@ -330,7 +350,8 @@ def run_chaos(cycles: int, jobs: int, out: str | None, workdir: str | None,
         ref.seal_tick()
     ref.dispatch_sealed()
     ref_state = ref.state_host()
-    rec_state = load_state(ckpt_path, init_state(cfg, chaos_specs()))
+    rec_state = load_state(ckpt_path, init_state(cfg, chaos_specs()),
+                           cfg=cfg)
 
     import jax
     diverged = []
@@ -376,6 +397,279 @@ def run_chaos(cycles: int, jobs: int, out: str | None, workdir: str | None,
     return report
 
 
+# --------------------------------------------------------------------------
+# --batch: the preemption plane's chaos gate (core/preempt.py)
+# --------------------------------------------------------------------------
+
+# the composed resumable run the batch gate kills: compact SoA state +
+# forced event compression + the fault plane, checkpointing asynchronously
+# at every chunk boundary. The chaos harness and the reference template
+# builder below must agree on this EXACT command shape (churn_bursts_setup
+# is the one shared definition).
+_BATCH_FLAGS = ["--config", "churn_bursts", "--quick", "--compact", "on",
+                "--time-compress", "always"]
+EXIT_PREEMPTED = 75  # core/preempt.py EXIT_PREEMPTED (sysexits EX_TEMPFAIL)
+
+
+def _batch_env(n_dev: int) -> dict:
+    """CPU-pinned child env with the virtual-device count fixed before jax
+    initializes (the bench child-re-exec discipline — MCS_CHAOS_CHILD is in
+    bench._CHILD_MARKERS, so the child neither re-pins to the TPU nor
+    writes the bench results record)."""
+    import bench
+    return bench._cpu_child_env("MCS_CHAOS_CHILD", n_devices=n_dev)
+
+
+def _bench_cmd(ckpt_base: str, resume: bool) -> list:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, os.path.join(root, "bench.py")] + _BATCH_FLAGS \
+        + ["--checkpoint", ckpt_base]
+    if resume:
+        cmd.append("--resume")
+    return cmd
+
+
+def _wait_progress(ckpt_file: str, proc, n_updates: int, final_t: int,
+                   timeout: float = 900.0):
+    """Block until the child's checkpoint advanced ``n_updates`` chunk
+    boundaries past its current point (or the run's final tick, or child
+    exit). Returns ('progress'|'final'|'exited', last_t)."""
+    from multi_cluster_simulator_tpu.core.checkpoint import peek_checkpoint_t
+
+    def peek():
+        try:
+            return peek_checkpoint_t(ckpt_file)
+        except (OSError, ValueError):
+            return None  # absent (atomic rename: never torn)
+
+    last = peek()
+    seen = 0
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        t = peek()
+        if t is not None and (last is None or t > last):
+            last = t
+            seen += 1
+            if t >= final_t:
+                return "final", last
+            if seen >= n_updates:
+                return "progress", last
+        if proc.poll() is not None:
+            return "exited", last
+        time.sleep(0.02)
+    raise RuntimeError(
+        f"batch chaos: no checkpoint progress within {timeout}s "
+        f"(last t={last})")
+
+
+def _run_to_completion(cmd, env, cwd, label, timeout=3600):
+    proc = subprocess.run(cmd, env=env, cwd=cwd, capture_output=True,
+                          text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"batch chaos: {label} child failed rc={proc.returncode}:\n"
+            f"{proc.stderr[-4000:]}")
+    return proc
+
+
+def _batch_scenario(n_dev: int, kills: int, workdir: str, rng,
+                    sigterm_cycles: int = 1) -> dict:
+    """One device-count cell: uninterrupted reference, then kill -9 the
+    resumable child at ``kills`` randomized chunk boundaries (+
+    ``sigterm_cycles`` SIGTERM save-and-exit cycles), finish, and assert
+    the final checkpoint bit-identical to the reference's."""
+    import numpy as np
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _batch_env(n_dev)
+    d = os.path.join(workdir, f"dev{n_dev}")
+    os.makedirs(d, exist_ok=True)
+    ref_base = os.path.join(d, "ref.ckpt")
+    chaos_base = os.path.join(d, "chaos.ckpt")
+    # bench suffixes the per-config checkpoint file (bench.main run_one)
+    ref_file = ref_base + ".churn_bursts"
+    chaos_file = chaos_base + ".churn_bursts"
+
+    # the workload's total tick count, from the ONE shared shape definition
+    import bench
+    cfg, specs, arrivals, n_ticks, fault_events = bench.churn_bursts_setup(
+        quick=True)
+    final_t = n_ticks * cfg.tick_ms
+
+    t0 = time.time()
+    print(f"# batch chaos [{n_dev} dev]: uninterrupted reference...",
+          file=sys.stderr)
+    _run_to_completion(_bench_cmd(ref_base, resume=False), env, root,
+                       f"{n_dev}dev reference")
+
+    kills_done = 0
+    term_exits = 0
+    completed_early = 0
+    restarts = 0
+    boundaries_killed_at = []
+
+    def _restart_from_scratch():
+        # a child completed while signal cycles are still owed: once the
+        # checkpoint holds the final state, every further incarnation
+        # exits instantly with zero progress — so drop the chaos
+        # checkpoint and let the remaining signals land on a fresh run
+        # (the bit-identity gate is unaffected: every kill/resume
+        # sequence, fresh or not, must end at the reference state)
+        nonlocal restarts
+        if os.path.exists(chaos_file):
+            os.remove(chaos_file)
+            restarts += 1
+    while kills_done < kills or term_exits < sigterm_cycles:
+        resume = os.path.exists(chaos_file)
+        proc = subprocess.Popen(_bench_cmd(chaos_base, resume=resume),
+                                env=env, cwd=root,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE, text=True)
+        use_term = term_exits < sigterm_cycles and kills_done >= 1
+        try:
+            # randomized boundary: 1-2 fresh checkpoint writes past the
+            # resume point, then the signal lands (16 boundaries at the
+            # quick shape comfortably cover the cycle budget)
+            status, last_t = _wait_progress(
+                chaos_file, proc, int(rng.integers(1, 3)), final_t)
+        except BaseException:
+            proc.kill()
+            proc.wait()
+            raise
+        if status in ("exited", "final"):
+            # the child outran the killer (or finished) — let it complete
+            # (surfacing any failure), then spawn another incarnation if
+            # more signal cycles are still owed
+            rc = proc.wait()
+            err = proc.stderr.read() if proc.stderr else ""
+            if rc != 0:
+                raise RuntimeError(
+                    f"batch chaos: child failed rc={rc} before a signal "
+                    f"landed:\n{err[-4000:]}")
+            completed_early += 1
+            if completed_early > kills + sigterm_cycles + 2:
+                raise RuntimeError(
+                    "batch chaos: children keep completing before a signal "
+                    "can land — the run is too short for the cycle count")
+            _restart_from_scratch()  # cycles are still owed (loop cond)
+            continue
+        assert proc.poll() is None, "child exited between progress and kill"
+        if use_term:
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=300)
+            err = proc.stderr.read() if proc.stderr else ""
+            if rc == 0 or rc == -signal.SIGTERM:
+                # the SIGTERM raced the guarded window: either the child
+                # completed its very last boundary first (rc 0), or the
+                # signal landed after _engine_run restored the default
+                # handler — during post-run stats/printing — and killed
+                # it (rc -SIGTERM). Neither is a save-and-exit failure
+                # (the guard only owns the chunk loop); owe the cycle and
+                # try again on the next incarnation.
+                completed_early += 1
+                _restart_from_scratch()
+                continue
+            assert rc == EXIT_PREEMPTED, (
+                f"SIGTERM child exited rc={rc}, expected {EXIT_PREEMPTED} "
+                f"(clean save-and-exit):\n{err[-2000:]}")
+            assert "# preempted: checkpoint saved" in err, (
+                "SIGTERM child never announced its preemption save:\n"
+                + err[-2000:])
+            term_exits += 1
+        else:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            if proc.stderr:
+                proc.stderr.close()
+            kills_done += 1
+            boundaries_killed_at.append(int(last_t) // cfg.tick_ms)
+
+    # the final incarnation runs to completion
+    final = _run_to_completion(_bench_cmd(chaos_base, resume=True), env,
+                               root, f"{n_dev}dev final resume")
+    assert "resumed from" in final.stderr, (
+        "final incarnation did not resume from the chaos checkpoint")
+
+    # ---- verification: bit-identical final state, telescoped cursors ----
+    import jax
+
+    from multi_cluster_simulator_tpu.core import preempt
+    from multi_cluster_simulator_tpu.core.compact import derive_plan
+    from multi_cluster_simulator_tpu.core.state import init_state
+
+    plan = derive_plan(cfg, specs, arrivals)
+    pdigest = preempt.policy_digest_for(cfg)
+
+    def load(path):
+        template = init_state(cfg, specs, plan=plan,
+                              fault_events=fault_events)
+        return preempt.load_run(path, template, cfg=cfg, plan=plan,
+                                policy_digest=pdigest)
+
+    ref_rc, chaos_rc = load(ref_file), load(chaos_file)
+    diverged = []
+    ref_leaves = jax.tree_util.tree_leaves_with_path(ref_rc.state)
+    got_leaves = jax.tree_util.tree_leaves_with_path(chaos_rc.state)
+    for (pa, la), (_pb, lb) in zip(ref_leaves, got_leaves):
+        if not np.array_equal(np.asarray(la), np.asarray(lb)):
+            diverged.append(jax.tree_util.keystr(pa))
+    assert not diverged, (
+        f"batch chaos [{n_dev} dev]: recovered final state DIVERGED from "
+        f"the uninterrupted reference on {len(diverged)} leaves: "
+        f"{diverged[:6]} — preemption is not replay-invisible")
+    # the compression cursors must telescope across the kill/resume cycles
+    # to exactly the uninterrupted run's totals
+    assert chaos_rc.meta.get("ticks_executed") == \
+        ref_rc.meta.get("ticks_executed"), (
+        f"cumulative ticks_executed diverged: chaos "
+        f"{chaos_rc.meta.get('ticks_executed')} vs reference "
+        f"{ref_rc.meta.get('ticks_executed')}")
+    return {
+        "n_devices": n_dev,
+        "kills": kills_done,
+        "sigterm_preemptions": term_exits,
+        "completed_before_signal": completed_early,
+        "restarts_from_scratch": restarts,
+        "boundaries_killed_at_tick": boundaries_killed_at,
+        "ticks_total": n_ticks,
+        "ticks_executed_compressed": int(ref_rc.meta["ticks_executed"]),
+        "final_state_bit_identical": True,
+        "cursors_telescope": True,
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def run_batch_chaos(cycles: int, quick: bool, out, workdir,
+                    keep: bool = False) -> dict:
+    """The batch-tier chaos matrix: per-device-count scenarios, each
+    >= ``cycles`` kill -9/resume rounds + one SIGTERM save-and-exit. Full
+    mode runs 1 device and the 8-virtual-device mesh (the acceptance
+    matrix); quick runs 1 device plus a 2-device sharded resume A/B."""
+    import numpy as np
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    dirpath = workdir or tempfile.mkdtemp(prefix="mcs-chaos-batch-")
+    rng = np.random.default_rng(101)
+    scenarios = ([(1, cycles), (2, 1)] if quick
+                 else [(1, cycles), (8, cycles)])
+    report = {"mode": "batch", "flags": " ".join(_BATCH_FLAGS),
+              "scenarios": []}
+    for n_dev, kills in scenarios:
+        report["scenarios"].append(
+            _batch_scenario(n_dev, kills, dirpath, rng))
+        s = report["scenarios"][-1]
+        print(f"# batch chaos [{n_dev} dev]: {s['kills']} kill -9 + "
+              f"{s['sigterm_preemptions']} SIGTERM cycles, bit-identical, "
+              f"{s['wall_s']}s", file=sys.stderr)
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+    if not keep and workdir is None:
+        import shutil
+        shutil.rmtree(dirpath, ignore_errors=True)
+    return report
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -388,6 +682,11 @@ def main():
     ap.add_argument("--dir", default=None, help="workdir (kept if given)")
     ap.add_argument("--serve", action="store_true", help="child mode")
     ap.add_argument("--url-file", default=None)
+    ap.add_argument("--batch", action="store_true",
+                    help="batch-tier preemption chaos: kill -9 a resumable "
+                         "bench churn_bursts child (compact + compression "
+                         "+ faults composed) at randomized chunk "
+                         "boundaries, resume, assert bit-identical")
     args = ap.parse_args()
 
     if args.serve:
@@ -398,6 +697,11 @@ def main():
         return
 
     cycles = args.cycles or (2 if args.quick else 5)
+    if args.batch:
+        report = run_batch_chaos(cycles, args.quick, args.out, args.dir,
+                                 keep=args.dir is not None)
+        print(json.dumps(report, indent=2))
+        return
     # a CAP, not a target: clients are duration-driven (they outlast the
     # chaos loop) and paced, so the cap only guards a runaway
     jobs = args.jobs or (20_000 if args.quick else 60_000)

@@ -153,9 +153,28 @@ def _cell_stats(state, C, jobs_per):
             "drops": total_drops(state)}
 
 
+def _grid_digest(policies, seeds, C, jobs_per, horizon_ms, drain_ticks,
+                 cfg, variant_params):
+    """Digest of everything that makes two sweeps THE SAME grid: lineup,
+    seeds, shape, the full SimConfig, and every variant's concrete param
+    leaves — the validity record the --resume cell cache is keyed by
+    (the checkpoint-header discipline, core/checkpoint.py)."""
+    from multi_cluster_simulator_tpu.core.checkpoint import (
+        config_describe, digest_of,
+    )
+    from multi_cluster_simulator_tpu.policies import params_digest
+
+    desc = {"policies": list(policies), "seeds": list(seeds), "C": C,
+            "jobs_per": jobs_per, "horizon_ms": horizon_ms,
+            "drain_ticks": drain_ticks, "config": config_describe(cfg),
+            "params": [params_digest(p) for p in variant_params]}
+    return digest_of(desc)
+
+
 def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
                    horizon_ms=240_000, drain_ticks=80, verify_cells=True,
-                   shard_seeds="auto", device_ab=False, shard_devices=None):
+                   shard_seeds="auto", device_ab=False, shard_devices=None,
+                   resume_path=None):
     """Run the (policy, seed) grid; returns the tournament detail dict.
 
     Gates (raise on violation — CI runs this via bench.py --tournament):
@@ -163,6 +182,16 @@ def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
     - every cell's final state is bit-identical to its standalone
       single-policy run (``verify_cells``);
     - no cell drops work (bounds sized for the lineup).
+
+    ``resume_path`` makes a killed sweep a restartable unit (the
+    preemption-plane discipline, core/preempt.py): after each variant's
+    cells pass the standalone-equality gate, its (policy, seed) results
+    are persisted to the JSON cache together with the GRID DIGEST
+    (lineup + seeds + shape + config + concrete param leaves); a rerun
+    with the same grid re-runs only the missing variants and merges the
+    cached rows. Only VERIFIED cells are ever persisted — resume can
+    never bypass the equality gate — and a digest mismatch fails fast
+    naming the cache, never silently mixes two different sweeps.
 
     ``device_ab=True`` (with a sharded replication axis) re-runs the whole
     grid through a FRESH jit over single-device inputs and records both
@@ -256,48 +285,91 @@ def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
             stacked)
 
     variant_params = [pset.params_for(cfg, name) for name in policies]
+
+    # --resume: the verified-cell cache (grid-digest keyed)
+    resume_cells: dict = {}
+    grid_dig = None
+    if resume_path is not None:
+        if not verify_cells:
+            raise ValueError(
+                "--resume requires cell verification (verify_cells=True): "
+                "only verified cells are ever persisted, so an unverified "
+                "sweep has nothing legal to cache")
+        grid_dig = _grid_digest(policies, seeds, C, jobs_per, horizon_ms,
+                                drain_ticks, cfg, variant_params)
+        if os.path.exists(resume_path):
+            with open(resume_path) as f:
+                cache = json.load(f)
+            if cache.get("grid_digest") != grid_dig:
+                raise ValueError(
+                    f"{resume_path}: tournament resume cache was written "
+                    f"for a different grid (digest "
+                    f"{cache.get('grid_digest')!r} vs {grid_dig!r}) — the "
+                    "lineup, seeds, shape, config, or param leaves "
+                    "changed; delete the cache or point --resume elsewhere")
+            resume_cells = dict(cache.get("completed", {}))
+
+    def _persist(name, rows_for_variant):
+        if resume_path is None:
+            return
+        resume_cells[name] = rows_for_variant
+        tmp = resume_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"grid_digest": grid_dig,
+                       "completed": resume_cells}, f, indent=1)
+        os.replace(tmp, resume_path)
+
+    fresh = [v for v, name in enumerate(policies)
+             if name not in resume_cells]
     from multi_cluster_simulator_tpu.obs.profile import annotate_dispatch
     t0 = time.time()
-    with annotate_dispatch("tournament", variants=len(variant_params),
+    with annotate_dispatch("tournament", variants=len(fresh),
                            seeds=n_seeds):
-        grid = [jax.block_until_ready(fn(state0, stacked, p))
-                for p in variant_params]
+        grid = {v: jax.block_until_ready(fn(state0, stacked,
+                                            variant_params[v]))
+                for v in fresh}
     tournament_wall = time.time() - t0
-    cache_size = getattr(fn, "_cache_size", lambda: None)()
-    if cache_size is None:
-        # fail loudly rather than fabricate a passing gate: a jax that
-        # renames the cache probe would otherwise let a recompile-per-
-        # variant regression ship with compiled_programs silently "1"
-        raise AssertionError(
-            "jit cache probe unavailable (jax renamed _cache_size?) — "
-            "update the compile-count gate in tools/tournament.py")
-    if cache_size != 1:
-        raise AssertionError(
-            f"tournament compiled {cache_size} programs for "
-            f"{len(policies)}x{n_seeds} cells — compile count must be "
-            "independent of sweep size (exactly one)")
+    if fresh:
+        cache_size = getattr(fn, "_cache_size", lambda: None)()
+        if cache_size is None:
+            # fail loudly rather than fabricate a passing gate: a jax that
+            # renames the cache probe would otherwise let a recompile-per-
+            # variant regression ship with compiled_programs silently "1"
+            raise AssertionError(
+                "jit cache probe unavailable (jax renamed _cache_size?) — "
+                "update the compile-count gate in tools/tournament.py")
+        if cache_size != 1:
+            raise AssertionError(
+                f"tournament compiled {cache_size} programs for "
+                f"{len(fresh)}x{n_seeds} cells — compile count must be "
+                "independent of sweep size (exactly one)")
+    else:
+        cache_size = 0  # everything resumed; no grid program ran
 
     shard_ab = None
-    if device_ab and sharded:
+    if device_ab and sharded and fresh:
         # the measured trace-parallel win: the SAME grid through a fresh
         # jit over single-device inputs (one compile each side — walls
         # compare runs only), plus the direct bitwise gate
         fn1 = jax.jit(grid_fn)
-        one = [jax.block_until_ready(fn1(state0, stacked_host, p))
-               for p in variant_params]  # compile + correctness run
-        for g_sh, g_1 in zip(grid, one):
-            for la, lb in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_1)):
+        one = {v: jax.block_until_ready(fn1(state0, stacked_host,
+                                            variant_params[v]))
+               for v in fresh}  # compile + correctness run
+        for v in fresh:
+            for la, lb in zip(jax.tree.leaves(grid[v]),
+                              jax.tree.leaves(one[v])):
                 if not np.array_equal(np.asarray(la), np.asarray(lb)):
                     raise AssertionError(
                         "sharded replication grid diverges from the "
                         "single-device grid — sharding must be invisible")
         t0 = time.time()
-        for p in variant_params:
-            jax.block_until_ready(fn1(state0, stacked_host, p))
+        for v in fresh:
+            jax.block_until_ready(fn1(state0, stacked_host,
+                                      variant_params[v]))
         one_wall = time.time() - t0
         t0 = time.time()
-        for p in variant_params:
-            jax.block_until_ready(fn(state0, stacked, p))
+        for v in fresh:
+            jax.block_until_ready(fn(state0, stacked, variant_params[v]))
         sh_wall = time.time() - t0
         shard_ab = {"devices": n_dev,
                     "sharded_wall_s": round(sh_wall, 3),
@@ -309,37 +381,16 @@ def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
     # one compile per variant — the market_ab shape) — both the recorded
     # baseline wall AND the bit-equality oracle for every cell. Skipped
     # entirely under verify_cells=False: the loop exists only for the
-    # comparison, so --no-verify also skips the baseline wall.
+    # comparison, so --no-verify also skips the baseline wall. Resumed
+    # variants are skipped too: their cells passed this exact gate before
+    # they were persisted (_persist runs only after verification).
     serial_wall = None
-    rows = []
     mismatches = []
-    if verify_cells:
-        # the baseline wall times ONLY the engine-build + trace/compile +
-        # runs (what the pre-zoo workflow actually paid per variant) —
-        # the equality comparison below is verification overhead and is
-        # timed out of the baseline
-        serial_wall = 0.0
-        for v, name in enumerate(policies):
-            t0 = time.time()
-            eng1 = Engine(cfg, policies=PolicySet((name,)))
-            fn1 = eng1.run_jit()
-            refs = [jax.block_until_ready(fn1(state0, tas[si], n_ticks))
-                    for si in range(n_seeds)]
-            serial_wall += time.time() - t0
-            for si, ref in enumerate(refs):
-                cell = jax.tree.map(lambda a, i=si: a[i], grid[v])
-                for la, lb in zip(jax.tree.leaves(cell),
-                                  jax.tree.leaves(ref)):
-                    if not np.array_equal(np.asarray(la), np.asarray(lb)):
-                        mismatches.append((name, seeds[si]))
-                        break
-    if mismatches:
-        raise AssertionError(
-            "tournament cells diverge from their standalone runs: "
-            f"{sorted(set(mismatches))}")
+    variant_rows: dict = {}
 
-    for v, name in enumerate(policies):
+    def _rows_for(v, name):
         digest = params_digest(variant_params[v])
+        out = []
         for si, s in enumerate(seeds):
             cell = jax.tree.map(lambda a, i=si: a[i], grid[v])
             stats = _cell_stats(cell, C, jobs_per)
@@ -347,8 +398,52 @@ def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
                 raise AssertionError(
                     f"tournament cell ({name}, seed {s}) dropped work "
                     f"({stats['drops']}) — resize the tournament config")
-            rows.append({"policy": name, "params_digest": digest,
-                         "seed": s, **stats})
+            out.append({"policy": name, "params_digest": digest,
+                        "seed": s, **stats})
+        return out
+
+    if verify_cells:
+        # the baseline wall times ONLY the engine-build + trace/compile +
+        # runs (what the pre-zoo workflow actually paid per variant) —
+        # the equality comparison below is verification overhead and is
+        # timed out of the baseline
+        serial_wall = 0.0
+        for v, name in enumerate(policies):
+            if v not in grid:
+                continue  # resumed variant
+            t0 = time.time()
+            eng1 = Engine(cfg, policies=PolicySet((name,)))
+            fn1 = eng1.run_jit()
+            refs = [jax.block_until_ready(fn1(state0, tas[si], n_ticks))
+                    for si in range(n_seeds)]
+            serial_wall += time.time() - t0
+            bad = False
+            for si, ref in enumerate(refs):
+                cell = jax.tree.map(lambda a, i=si: a[i], grid[v])
+                for la, lb in zip(jax.tree.leaves(cell),
+                                  jax.tree.leaves(ref)):
+                    if not np.array_equal(np.asarray(la), np.asarray(lb)):
+                        mismatches.append((name, seeds[si]))
+                        bad = True
+                        break
+            if not bad:
+                variant_rows[name] = _rows_for(v, name)
+                _persist(name, variant_rows[name])
+    if mismatches:
+        raise AssertionError(
+            "tournament cells diverge from their standalone runs: "
+            f"{sorted(set(mismatches))}")
+
+    rows = []
+    resumed_variants = []
+    for v, name in enumerate(policies):
+        if name in variant_rows:
+            rows.extend(variant_rows[name])
+        elif v not in grid and name in resume_cells:
+            resumed_variants.append(name)
+            rows.extend([{**r, "resumed": True} for r in resume_cells[name]])
+        else:  # verify_cells=False: stats straight off the grid
+            rows.extend(_rows_for(v, name))
 
     # rank: most work placed, then lowest mean wait, aggregated over seeds
     agg = {}
@@ -375,6 +470,8 @@ def run_tournament(policies=DEFAULT_POLICIES, n_seeds=4, C=64, jobs_per=120,
         "pack_once_s": round(pack_s, 3),
         "tournament_wall_s": round(tournament_wall, 3),
         "cells_bit_identical_to_standalone": bool(verify_cells),
+        **({"resumed_variants": resumed_variants,
+            "grid_digest": grid_dig} if resume_path is not None else {}),
         "ranking": ranking,
         "rows": rows,
     }
@@ -406,6 +503,12 @@ def main(argv=None):
                     help="also run the grid single-device through a fresh "
                          "jit and record the measured device speedup + the "
                          "bitwise sharded==unsharded gate")
+    ap.add_argument("--resume", metavar="PATH", default=None,
+                    help="verified-cell cache: completed (policy, seed) "
+                         "results persist here (with the grid digest) as "
+                         "each variant passes the standalone-equality "
+                         "gate, so a killed sweep re-runs only missing "
+                         "cells; a digest mismatch fails fast")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tournament.json"))
     args = ap.parse_args(argv)
@@ -413,7 +516,8 @@ def main(argv=None):
               C=args.clusters, jobs_per=args.jobs,
               horizon_ms=args.horizon_ms,
               verify_cells=not args.no_verify,
-              shard_seeds=args.shard, device_ab=args.device_ab)
+              shard_seeds=args.shard, device_ab=args.device_ab,
+              resume_path=args.resume)
     if args.quick:
         kw.update(policies=tuple(args.policies[:4]) if len(args.policies) > 4
                   else tuple(args.policies),

@@ -30,12 +30,25 @@ import numpy as np
 
 
 def train(iters=10, n_envs=32, n_clusters=4, episode_ticks=20, lr=0.5,
-          sigma=0.3, seed=0, rate=2.0, reward="neg_mean_wait"):
+          sigma=0.3, seed=0, rate=2.0, reward="neg_mean_wait",
+          checkpoint=None, resume=False, faults=None):
     """Run ``iters`` ES iterations; returns a dict with the per-iteration
     mean returns, the trained head, and timing. Deterministic for a fixed
     seed (common random numbers: every iteration reuses the same per-env
     reset keys, so fitness differences come from the head, not the
-    draw)."""
+    draw).
+
+    ``checkpoint`` saves a per-iteration training bundle (the preemption
+    plane's format, core/preempt.py): the reset EnvState batch — which
+    carries every env's fault-plane churn streams (``faults.reseed``) and
+    PRNG state — the ES optimizer state (the head ``W`` + the ES key),
+    and the per-iteration returns. ``resume=True`` continues a killed run
+    bit-identically: the loaded bundle replaces BOTH the optimizer state
+    and the reset batch (never re-derived — the test pins that the
+    per-env fault streams survive the round-trip), and the remaining
+    iterations produce exactly the uninterrupted run's head and returns
+    (tests/test_preempt.py). ``faults`` is an optional FaultConfig for
+    churn-during-training."""
     import jax
     import jax.numpy as jnp
 
@@ -47,6 +60,9 @@ def train(iters=10, n_envs=32, n_clusters=4, episode_ticks=20, lr=0.5,
     cfg = SimConfig(policy=PolicyKind.FIFO, parity=True, n_res=2,
                     queue_capacity=16, max_running=64, max_arrivals=8,
                     max_ingest_per_tick=8, max_nodes=5, max_virtual_nodes=0)
+    if faults is not None:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, faults=faults)
     # heterogeneous nodes (the tournament's shape): the last two slots are
     # accelerator-typed, so the class -> device-type action matrix has
     # something real to steer
@@ -95,10 +111,34 @@ def train(iters=10, n_envs=32, n_clusters=4, episode_ticks=20, lr=0.5,
     W = jnp.zeros((env.n_obs, act_dim), jnp.float32)
     key = jax.random.PRNGKey(seed + 1)
     means = []
+    start_iter = 0
+    if checkpoint is not None and resume and os.path.exists(checkpoint):
+        from multi_cluster_simulator_tpu.core import checkpoint as ckio
+
+        bundle = ckio.load_tree(
+            checkpoint, {"W": W, "key": key, "es0": es0, "obs0": obs0},
+            cfg=cfg)
+        extra = ckio.load_extra(checkpoint)
+        W, key = bundle["W"], bundle["key"]
+        # the RESET batch is restored, not re-derived: es0 carries every
+        # env's per-env fault streams and PRNG state, and a resumed
+        # iteration must roll out against the exact same batch
+        es0, obs0 = bundle["es0"], bundle["obs0"]
+        start_iter = int(extra.get("iter", 0))
+        means = list(extra.get("means", []))
+        print(f"# resumed ES training from {checkpoint} at iter "
+              f"{start_iter}", file=sys.stderr)
     t0 = time.time()
-    for i in range(iters):
+    for i in range(start_iter, iters):
         W, key, mean_ret = it_fn(W, key)
         means.append(float(mean_ret))
+        if checkpoint is not None:
+            from multi_cluster_simulator_tpu.core import checkpoint as ckio
+
+            ckio.save_tree(
+                {"W": W, "key": key, "es0": es0, "obs0": obs0},
+                checkpoint, t=i + 1,
+                extra={"iter": i + 1, "means": means}, cfg=cfg)
     wall = time.time() - t0
     return {
         "mean_return_per_iter": means,
@@ -121,10 +161,19 @@ def main(argv=None):
     ap.add_argument("--episode-ticks", type=int, default=20)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--reward", default="neg_mean_wait")
+    ap.add_argument("--checkpoint", metavar="PATH", default=None,
+                    help="save the training bundle (EnvState batch + ES "
+                         "optimizer state + PRNG keys) after every "
+                         "iteration")
+    ap.add_argument("--resume", action="store_true",
+                    help="continue a killed run from --checkpoint "
+                         "bit-identically (per-env fault streams survive "
+                         "the round-trip)")
     args = ap.parse_args(argv)
     res = train(iters=args.iters, n_envs=args.envs,
                 n_clusters=args.clusters, episode_ticks=args.episode_ticks,
-                seed=args.seed, reward=args.reward)
+                seed=args.seed, reward=args.reward,
+                checkpoint=args.checkpoint, resume=args.resume)
     print(f"# {res['episodes_simulated']} episodes "
           f"({res['envs']} envs x {res['iters']} iters x "
           f"{res['episode_ticks']} ticks) in {res['wall_s']} s, "

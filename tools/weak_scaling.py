@@ -147,9 +147,15 @@ def pad_constellation(cfg, specs, arrivals, n_shards):
 
 
 def _run_shape(cfg, specs, arrivals, n_ticks, n_dev, repeats=2, chunk=200,
-               compact=True, stream="auto", time_compress="auto"):
+               compact=True, stream="auto", time_compress="auto",
+               ckpt=None, resume=False):
     """One measured row through bench._engine_run with the mesh pinned to
-    ``n_dev`` devices; returns (final_state, row_detail)."""
+    ``n_dev`` devices; returns (final_state, row_detail). ``ckpt`` arms
+    the preemption plane for the row (core/preempt.py: async per-chunk
+    RunCheckpoints + SIGTERM save-and-exit; ``resume`` continues a killed
+    row bit-identically) — the long Borg-scale record row is the consumer,
+    so a multi-hour 10M-job run is a restartable unit, not an
+    all-or-nothing job."""
     import jax
 
     import bench
@@ -158,9 +164,15 @@ def _run_shape(cfg, specs, arrivals, n_ticks, n_dev, repeats=2, chunk=200,
     bench._PIPELINE["mode"] = "on"
     bench._PIPELINE["stream"] = stream
     bench._TIME_COMPRESS["mode"] = time_compress
-    out, wall_s, compile_s, _, info = bench._engine_run(
-        cfg, specs, arrivals, n_ticks, use_mesh=n_dev > 1, chunk=chunk,
-        repeats=repeats, warmups=0, tick_indexed=True, mesh_devices=n_dev)
+    saved_ckpt = dict(bench._CKPT)
+    bench._CKPT.update(path=ckpt, resume=bool(resume))
+    try:
+        out, wall_s, compile_s, _, info = bench._engine_run(
+            cfg, specs, arrivals, n_ticks, use_mesh=n_dev > 1, chunk=chunk,
+            repeats=repeats, warmups=0, tick_indexed=True,
+            mesh_devices=n_dev)
+    finally:
+        bench._CKPT.update(saved_ckpt)
     placed = int(np.asarray(out.placed_total).sum())
     drops = bench._assert_zero_drops(out, f"weak_scaling[{n_dev}dev]")
     row = {
@@ -176,7 +188,8 @@ def _run_shape(cfg, specs, arrivals, n_ticks, n_dev, repeats=2, chunk=200,
         "devices_visible": len(jax.devices()),
     }
     for k in ("policy", "state_bytes", "arrivals_bytes", "h2d_bytes",
-              "tick_bytes_accessed", "time_compress", "pipeline", "compact"):
+              "tick_bytes_accessed", "time_compress", "pipeline", "compact",
+              "checkpoint"):
         if info.get(k) is not None:
             row[k] = info[k]
     tc = info.get("time_compress", {})
@@ -234,16 +247,21 @@ def run_market_row(per_device, n_dev, jobs_per, horizon_ms, repeats=1):
     return row
 
 
-def run_record(n_dev, per_device, bursts, per_burst, interval_ms):
+def run_record(n_dev, per_device, bursts, per_burst, interval_ms,
+               ckpt=None, resume=False):
     """The Borg-scale streamed record: 10M+ jobs end-to-end with every
     composition engaged — compact state, per-shard streamed H2D prefetch
-    (forced), donated buffers, event-compressed valleys."""
+    (forced), donated buffers, event-compressed valleys. With ``ckpt``
+    the record is preemption-proof: per-chunk async RunCheckpoints (the
+    sharded state gathers at the boundary, restore re-shards), SIGTERM
+    saves-and-exits, and a ``--resume`` rerun continues bit-identically."""
     C = per_device * n_dev
     cfg, specs, arrivals, n_ticks = _record_constellation(
         C, bursts, per_burst, interval_ms)
     total = C * bursts * per_burst
     out, row = _run_shape(cfg, specs, arrivals, n_ticks, n_dev, repeats=1,
-                          chunk=100, stream="always", time_compress="auto")
+                          chunk=100, stream="always", time_compress="auto",
+                          ckpt=ckpt, resume=resume)
     assert row["jobs"] >= 0.99 * total, (
         f"record run placed only {row['jobs']}/{total}")
     row["kind"] = "borg_scale_streamed_record"
@@ -366,6 +384,13 @@ def main(argv=None):
                          "efficiency lands below this (the CI gate)")
     ap.add_argument("--skip-market", action="store_true")
     ap.add_argument("--skip-record", action="store_true")
+    ap.add_argument("--checkpoint", metavar="PATH", default=None,
+                    help="arm the preemption plane for the Borg-scale "
+                         "record row: async per-chunk RunCheckpoints to "
+                         "PATH, SIGTERM save-and-exit (core/preempt.py)")
+    ap.add_argument("--resume", action="store_true",
+                    help="continue a killed record row from --checkpoint "
+                         "(bit-exact)")
     args = ap.parse_args(argv)
 
     devices = tuple(args.devices or ((1, 2) if args.quick else DEVICE_COUNTS))
@@ -426,7 +451,9 @@ def main(argv=None):
     if not args.skip_record and not args.quick:
         # 10.49M jobs: 32768 clusters x 16 bursts x 20 jobs
         record["record"] = run_record(max(devices), per_dev, bursts=16,
-                                      per_burst=20, interval_ms=180_000)
+                                      per_burst=20, interval_ms=180_000,
+                                      ckpt=args.checkpoint,
+                                      resume=args.resume)
     record["total_wall_s"] = round(time.time() - t0, 1)
 
     with open(out, "w") as f:
