@@ -28,6 +28,7 @@ from __future__ import annotations
 import argparse
 import collections
 import dataclasses
+import hashlib
 import json
 import os
 import sys
@@ -109,6 +110,16 @@ def _leapable(counts) -> bool:
 # bitwise identical (the metrics carry is provably write-only-to-itself)
 # and measured overhead <= max_overhead (CI runs this at quick scale).
 _OBS = {"mode": "off", "max_overhead": 0.03}
+
+# The fused tick kernel (kernels/fused_tick.py), set by main() from
+# --fused. "off" keeps the unfused XLA tick; "on" runs the ingest->schedule
+# span as ONE pallas_call per cluster block (interpret mode on non-TPU
+# backends — the CPU/CI oracle); "auto" engages only on a real TPU
+# backend; "ab" runs fused as the primary measurement, re-runs unfused,
+# and GATES: final states bitwise identical (state digests compared) and
+# the fused span's buffer-boundary bytes strictly below the per-phase
+# unfused executables' (the collapse the kernel exists for).
+_FUSED = {"mode": "off", "ab": False}
 
 # persistent-compilation-cache state, set by _setup_jax() so details can
 # report whether compile_s was paid cold or served warm from the cache
@@ -230,6 +241,13 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     )
     from multi_cluster_simulator_tpu.core.state import TickArrivals, init_state
 
+    from multi_cluster_simulator_tpu.kernels import fused_tick
+
+    # the fused tick kernel rides the config (a pure execution-strategy
+    # field: excluded from checkpoint digests, bit-identical by the
+    # interpret-mode oracle — tests/test_kernels.py)
+    if cfg.fused != _FUSED["mode"]:
+        cfg = dataclasses.replace(cfg, fused=_FUSED["mode"])
     plan = (derive_plan(cfg, specs, arrivals)
             if _COMPACT["mode"] == "on" else None)
     state = init_state(cfg, specs, plan=plan, fault_events=fault_events)
@@ -304,10 +322,20 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
     # a real multi-device mesh: the single-device lowering would be the
     # largest compile in the suite AND describe a different executable
     # than the sharded one that actually runs.
+    # fused-kernel provenance in every detail dict: mode + resolved block
+    # shape + phase span + interpret, so a recorded number names the
+    # executable that produced it (kernels/fused_tick.py)
+    info["fused"] = fused_tick.provenance(cfg,
+                                          C=int(state.arr_ptr.shape[0]))
     if use_mesh and n_dev > 1:
         info["tick_bytes_note"] = ("skipped: mesh run (an unsharded tick "
                                    "would not describe the sharded "
                                    "executable)")
+        if fused_tick.is_active(cfg):
+            # same skip, same reason: the span probe compiles
+            # single-device executables — the --fused ab gate keeps the
+            # bitwise digest check and waives only the bytes half here
+            info["fused"]["span_bytes_note"] = info["tick_bytes_note"]
     else:
         try:
             if tick_indexed and arr_host:
@@ -328,6 +356,13 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
                 .memory_analysis()
             info["tick_bytes_accessed"] = int(ma.argument_size_in_bytes
                                               + ma.output_size_in_bytes)
+            if fused_tick.is_active(cfg):
+                # the span-collapse instrument (compile-only): per-phase
+                # unfused executables' boundary bytes vs the ONE fused
+                # span executable's — what --fused ab gates on
+                info["fused"]["span_bytes"] = fused_tick.span_boundary_bytes(
+                    cfg, state, packed0[0], packed0[1],
+                    tick_indexed=bool(tick_indexed and arr_host))
         except Exception as e:  # no memory_analysis / OOM-shaped lowering
             info["tick_bytes_note"] = f"unavailable: {type(e).__name__}"
     # device metrics plane (obs/): a MetricsBuffer threaded through the
@@ -620,6 +655,17 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
             f"--obs ab: metrics-plane overhead {overhead:.1%} exceeds the "
             f"{_OBS['max_overhead']:.0%} bound (on {min(walls_ab_on):.3f}s "
             f"vs off {min(walls_off):.3f}s)")
+    # one digest over every final-state leaf: the bitwise-equality
+    # instrument the --fused ab gate compares without holding two full
+    # states alive across runs. Only computed when a fused mode (or its
+    # ab re-run, which flips the mode back to off) can consume it — a
+    # plain run must not pay a whole-state host transfer + hash at the
+    # record shapes (hundreds of MB of leaves)
+    if _FUSED["ab"] or _FUSED["mode"] != "off":
+        h = hashlib.sha1()
+        for leaf in jax.tree.leaves(out):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        info["state_digest"] = h.hexdigest()[:16]
     if tick_indexed:
         # time-compression provenance: executed vs simulated ticks and the
         # log2 leap histogram (bucket b = leaps of [2^b, 2^(b+1)) ticks) —
@@ -678,7 +724,8 @@ def _timing_detail(info):
     for k in ("pipeline", "h2d_bytes", "arrivals_bytes",
               "peak_hbm_process_bytes", "compile_cache", "time_compress",
               "state_bytes", "tick_bytes_accessed", "tick_bytes_note",
-              "compact", "policy", "mesh_devices", "obs", "checkpoint"):
+              "compact", "fused", "state_digest", "policy", "mesh_devices",
+              "obs", "checkpoint"):
         if info.get(k) is not None:
             out[k] = info[k]
     return out
@@ -2574,6 +2621,13 @@ def _setup_jax(cache_dir=None, cache_enabled=True):
         jax.config.update("jax_platforms", "cpu")
 
 
+# configs whose drivers bypass _engine_run (child re-exec, grid/serving
+# harnesses) or own their record cadence: the generic ab gates cannot
+# re-run them meaningfully — ONE list, shared by every ab site below
+_AB_EXCLUDED = ("parity_tpu", "live", "serving", "tournament", "env",
+                "multichip", "faults")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="headline", choices=sorted(CONFIGS))
@@ -2647,6 +2701,17 @@ def main():
                          "— every faults-on parity cell (compact x "
                          "time-compression x ragged chunks x 8-device "
                          "mesh) is bit-identical")
+    ap.add_argument("--fused", choices=("off", "on", "auto", "ab"),
+                    default="off",
+                    help="the fused ingest->schedule tick kernel "
+                         "(kernels/fused_tick.py): one pallas_call keeps "
+                         "each cluster block's queue/runset/node columns "
+                         "in VMEM across the span (interpret-mode oracle "
+                         "on non-TPU backends). auto engages only on a "
+                         "real TPU; ab runs fused then unfused and FAILS "
+                         "on any bitwise state divergence or on fused "
+                         "span buffer-boundary bytes not strictly below "
+                         "the per-phase unfused executables'")
     ap.add_argument("--obs", choices=("off", "on", "ab"), default="off",
                     help="device metrics plane (obs/): thread a "
                          "MetricsBuffer through the scan carry, harvested "
@@ -2684,6 +2749,8 @@ def main():
                               else args.time_compress)
     _OBS["mode"] = args.obs
     _OBS["max_overhead"] = args.obs_overhead_max
+    _FUSED["mode"] = "on" if args.fused == "ab" else args.fused
+    _FUSED["ab"] = args.fused == "ab"
 
     def run_one(name):
         # one checkpoint file per config: states from different configs have
@@ -2731,14 +2798,14 @@ def main():
 
         _PIPELINE["mode"] = "on" if args.pipeline == "ab" else args.pipeline
         res = call()
-        if args.pipeline == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip", "faults"):
+        if args.pipeline == "ab" and name not in _AB_EXCLUDED:
             ab_compare(res, _PIPELINE, "on", "pipeline_ab",
                        "pipelined", "unpipelined")
-        if args.time_compress == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip", "faults"):
+        if args.time_compress == "ab" and name not in _AB_EXCLUDED:
             ab_compare(res, _TIME_COMPRESS, "auto", "time_compress_ab",
                        "compressed", "dense",
                        extra=("ticks_executed", "ticks_simulated"))
-        if args.compact == "ab" and name not in ("parity_tpu", "live", "serving", "tournament", "env", "multichip", "faults"):
+        if args.compact == "ab" and name not in _AB_EXCLUDED:
 
             def compact_gates(d, doff, ab):
                 # correctness gate, not just walls: the wide re-run must
@@ -2775,6 +2842,50 @@ def main():
 
             ab_compare(res, _COMPACT, "on", "compact_ab",
                        "compact", "wide", post=compact_gates)
+        if args.fused == "ab" and name not in _AB_EXCLUDED:
+
+            def fused_gates(d, doff, ab):
+                # the standing kernel gate (ISSUE 15 acceptance): (1) the
+                # fused run's final state must be BITWISE the unfused
+                # run's — compared via the whole-state leaf digest each
+                # _engine_run records; (2) the fused span executable must
+                # stream strictly fewer buffer-boundary bytes than the
+                # per-phase unfused executables — the collapse the kernel
+                # exists for, measured by kernels.span_boundary_bytes
+                ab.update(fused_state_digest=d.get("state_digest"),
+                          unfused_state_digest=doff.get("state_digest"))
+                assert d.get("state_digest") is not None \
+                    and doff.get("state_digest") is not None, (
+                    f"--fused ab: {name} recorded no state digest — the "
+                    "bitwise gate has nothing to compare")
+                assert d["state_digest"] == doff["state_digest"], (
+                    f"--fused ab: {name} fused final state diverged "
+                    f"bitwise from unfused ({d['state_digest']} != "
+                    f"{doff['state_digest']})")
+                ab["state_bit_identical"] = True
+                fd = d.get("fused") or {}
+                sb = fd.get("span_bytes")
+                if sb is None and "span_bytes_note" in fd:
+                    # mesh run: the single-device span probe was skipped
+                    # for the same reason as tick_bytes_accessed — only
+                    # the bytes half of the gate is waived, and the skip
+                    # reason rides the detail
+                    ab["span_bytes_note"] = fd["span_bytes_note"]
+                else:
+                    assert sb is not None, (
+                        f"--fused ab: {name} recorded no span_bytes "
+                        "(Compiled.memory_analysis unavailable?) — the "
+                        "boundary-bytes gate has nothing to check")
+                    assert sb["fused"] < sb["unfused_total"], (
+                        f"--fused ab: {name} fused span streams MORE "
+                        f"buffer-boundary bytes than the per-phase unfused "
+                        f"executables ({sb['fused']} >= "
+                        f"{sb['unfused_total']}) — the kernel stopped "
+                        "collapsing the span")
+                    ab["span_bytes"] = sb
+
+            ab_compare(res, _FUSED, "on", "fused_ab",
+                       "fused", "unfused", post=fused_gates)
         return res
 
     # quick runs are smoke shapes — never let them clobber the full-run

@@ -189,6 +189,29 @@ class SimConfig:
     # of bit-parity). Same wave technique as ffd_sweep.
     delay_sweep: str = "wave"
 
+    # --- fused tick kernel (kernels/fused_tick.py) ---
+    # Execution STRATEGY, not semantics: the fused path is bit-identical to
+    # the unfused tick (the interpret-mode oracle + tests/test_kernels.py
+    # pin it), so these fields are excluded from the checkpoint config
+    # digest (core/checkpoint.config_describe) — a run may be checkpointed
+    # unfused and resumed fused, or vice versa.
+    #   "off"  — the unfused XLA tick (default; every pre-kernel path)
+    #   "on"   — always run the ingest->schedule span as ONE pallas_call
+    #            that keeps the block's queue/runset/node columns in VMEM
+    #            across the phase boundary (interpret-mode on non-TPU
+    #            backends unless fused_interpret pins it)
+    #   "auto" — fuse only where it pays: a real TPU backend (interpret
+    #            mode is an oracle, not a fast path — CPU stays unfused)
+    fused: str = "off"
+    # Cluster-block hint for the kernel grid: the actual block is the
+    # largest divisor of the (shard-local) cluster count <= this, so C
+    # never needs padding and blocking stays bitwise invisible.
+    fused_block: int = 256
+    # pallas_call(interpret=...) source of truth (simlint rule family 10
+    # forbids hardcoding it at call sites). None = interpret everywhere
+    # except a real TPU backend — the CPU/CI oracle contract.
+    fused_interpret: bool | None = None
+
     # --- instrumentation ---
     record_trace: bool = False  # record per-placement events
     max_trace_events: int = 1 << 16

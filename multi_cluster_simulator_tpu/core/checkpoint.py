@@ -70,11 +70,23 @@ def _canon_json(obj) -> str:
     return json.dumps(obj, sort_keys=True)
 
 
+# Execution-STRATEGY fields whose value cannot change results (the fused
+# tick kernel is pinned bit-identical to the unfused tick — PARITY.md
+# §fused kernel): excluded from the header description so a run may be
+# checkpointed unfused and resumed fused (or across backends, where the
+# interpret default flips) without tripping the config-digest check.
+_STRATEGY_FIELDS = ("fused", "fused_block", "fused_interpret")
+
+
 def config_describe(cfg) -> dict:
     """The full nested ``SimConfig`` as plain JSON-able data — stored in
     the header so a mismatch can name the differing FIELD, not just fail
-    a hash compare."""
-    return dataclasses.asdict(cfg)
+    a hash compare. Pure execution-strategy fields (``_STRATEGY_FIELDS``)
+    are dropped: they select HOW the same results are computed."""
+    d = dataclasses.asdict(cfg)
+    for f in _STRATEGY_FIELDS:
+        d.pop(f, None)
+    return d
 
 
 def digest_of(obj) -> str:

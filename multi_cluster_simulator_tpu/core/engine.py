@@ -630,6 +630,11 @@ class Engine:
             if v not in ("wave", "serial"):
                 raise ValueError(
                     f"{field} must be 'wave' or 'serial', got {v!r}")
+        if cfg.fused not in ("off", "on", "auto"):
+            raise ValueError(
+                f"fused must be 'off', 'on' or 'auto', got {cfg.fused!r}")
+        if cfg.fused_block < 1:
+            raise ValueError(f"fused_block must be >= 1, got {cfg.fused_block}")
         if cfg.trader.enabled and cfg.n_res != 3:
             raise ValueError("the trader market carves 3-dim resources; "
                              "set n_res=3 when trader.enabled")
@@ -644,6 +649,70 @@ class Engine:
                                                   ex=self.ex)
         else:
             self._trade_round = None
+
+    def fused_active(self) -> bool:
+        """Does this engine run the ingest->schedule span as the Pallas
+        kernel? ``off`` never, ``on`` always (interpret-mode on non-TPU
+        backends — the CPU/CI oracle), ``auto`` only where it pays: a real
+        TPU backend (kernels.fused_tick.is_active is the one definition)."""
+        from multi_cluster_simulator_tpu.kernels import fused_tick
+        return fused_tick.is_active(self.cfg)
+
+    def fused_provenance(self) -> dict:
+        """The fused-kernel provenance fields every bench/probe detail
+        dict records (mode + resolved block shape + phase span +
+        interpret), so a recorded number names the executable that
+        produced it."""
+        from multi_cluster_simulator_tpu.kernels import fused_tick
+        return fused_tick.provenance(self.cfg)
+
+    def _span_ingest_schedule(self, state: SimState, arr_rows, arr_n, t,
+                              params, tick_indexed: bool,
+                              do_ingest: bool = True,
+                              do_schedule: bool = True):
+        """Phases 4+5 on a batched state — the HOT SPAN. This one function
+        is both the unfused path (called on the full [C] state) and the
+        fused kernel's body (called on a [block] slice whose columns are
+        VMEM-resident, kernels/fused_tick.py), which is what makes the
+        fused path bit-identical by construction rather than by porting.
+
+        4. arrivals — the ingest target is the active policy's (Level0
+        for the queue-sweep families, ReadyQueue for FIFO). Static when
+        every compiled set member agrees (the singleton/classic case —
+        identical to the old cfg.policy branch); a mixed set switches on
+        the traced index, each branch bitwise the seed path.
+        5. scheduling pass: the policy zoo's dispatch (policies/base.py) —
+        the member params.idx selects runs its batched kernel; non-FIFO
+        members emit an all-False borrow_want."""
+        cfg = self.cfg
+        ingest = _ingest_packed_local if tick_indexed else _ingest_local
+
+        def run_ingest(s_, to_delay):
+            return jax.vmap(
+                functools.partial(ingest, cfg=cfg, to_delay=to_delay),
+                in_axes=(_STATE_AXES, 0, 0, None),
+                out_axes=_STATE_AXES)(s_, arr_rows, arr_n, t)
+
+        if do_ingest:
+            with phase_scope("ingest"):
+                to_delay = self.pset.ingest_to_delay()
+                if to_delay is not None:
+                    state = run_ingest(state, to_delay)
+                else:
+                    flag = self.pset.to_delay_table()[params.idx]
+                    state = jax.lax.cond(flag,
+                                         lambda s_: run_ingest(s_, True),
+                                         lambda s_: run_ingest(s_, False),
+                                         state)
+        if do_schedule:
+            with phase_scope("schedule"):
+                state, want, bjob_vec = self.pset.dispatch(state, t, params,
+                                                           cfg)
+        else:
+            C = state.arr_ptr.shape[0]
+            want = jnp.zeros((C,), bool)
+            bjob_vec = jnp.zeros((C, Q.NF), jnp.int32)
+        return state, want, bjob_vec
 
     def policy_provenance(self, params=None) -> dict:
         """(registered policy name(s), param digest) for detail dicts — the
@@ -768,43 +837,27 @@ class Engine:
                                  in_axes=(_STATE_AXES, None),
                                  out_axes=_STATE_AXES)(state, t)
 
-        # 4. arrivals — the ingest target is the active policy's (Level0
-        # for the queue-sweep families, ReadyQueue for FIFO). Static when
-        # every compiled set member agrees (the singleton/classic case —
-        # identical to the old cfg.policy branch); a mixed set switches on
-        # the traced index, each branch bitwise the seed path.
+        # 4+5. the ingest -> schedule span. The two phases are contiguous
+        # and per-cluster-local (the profile plane ranks the schedule pass
+        # the dominant tick cost — tools/profile_capture.py), so with
+        # ``cfg.fused`` they run as ONE Pallas kernel that loads each
+        # cluster block's queue/runset/node columns once, executes the span
+        # over them in VMEM, and writes each column back once
+        # (kernels/fused_tick.py). Bit-identical by construction: the
+        # kernel body executes ``_span_ingest_schedule`` itself on the
+        # block-resident values — same ops, same order, any state layout.
+        # ``run_prefix`` truncations inside the span fall back to the
+        # unfused path (a half-span is a diagnostic, not a kernel).
         arr_rows, arr_n = packed_arrivals
-        ingest = _ingest_packed_local if tick_indexed else _ingest_local
-
-        def run_ingest(s_, to_delay):
-            return jax.vmap(
-                functools.partial(ingest, cfg=cfg, to_delay=to_delay),
-                in_axes=(_STATE_AXES, 0, 0, None),
-                out_axes=_STATE_AXES)(s_, arr_rows, arr_n, t)
-
-        if phase_on(4):
-            with phase_scope("ingest"):
-                to_delay = self.pset.ingest_to_delay()
-                if to_delay is not None:
-                    state = run_ingest(state, to_delay)
-                else:
-                    flag = self.pset.to_delay_table()[params.idx]
-                    state = jax.lax.cond(flag,
-                                         lambda s_: run_ingest(s_, True),
-                                         lambda s_: run_ingest(s_, False),
-                                         state)
-
-        # 5. scheduling pass: the policy zoo's dispatch (policies/base.py) —
-        # the member params.idx selects runs its batched kernel; non-FIFO
-        # members emit an all-False borrow_want
-        if phase_on(5):
-            with phase_scope("schedule"):
-                state, want, bjob_vec = self.pset.dispatch(state, t, params,
-                                                           cfg)
+        if phase_on(5) and self.fused_active():
+            from multi_cluster_simulator_tpu.kernels import fused_tick
+            with phase_scope("fused_span"):
+                state, want, bjob_vec = fused_tick.fused_span(
+                    self, state, arr_rows, arr_n, t, params, tick_indexed)
         else:
-            C = state.arr_ptr.shape[0]
-            want = jnp.zeros((C,), bool)
-            bjob_vec = jnp.zeros((C, Q.NF), jnp.int32)
+            state, want, bjob_vec = self._span_ingest_schedule(
+                state, arr_rows, arr_n, t, params, tick_indexed,
+                do_ingest=phase_on(4), do_schedule=phase_on(5))
         # 6. borrow matching (FIFO-family cells only: want is identically
         # False elsewhere, making the match a bitwise no-op for those cells)
         if cfg.borrowing and self.pset.has_fifo and phase_on(6):

@@ -1,0 +1,14 @@
+"""Hand-written device kernels (Pallas).
+
+One kernel so far: the fused ingest->schedule tick span
+(``kernels/fused_tick.py``), gated by ``SimConfig.fused`` and pinned
+bit-identical to the unfused XLA tick via the interpret-mode oracle
+(ARCHITECTURE.md §fused tick kernel). simlint rule family 10
+(``pallas-kernel``, LINTING.md §10) enforces the kernel-body discipline
+for everything under this package.
+"""
+
+from multi_cluster_simulator_tpu.kernels.fused_tick import (  # noqa: F401
+    FUSED_SPAN, block_clusters, fused_span, interpret_mode, is_active,
+    provenance, span_boundary_bytes,
+)

@@ -1,0 +1,290 @@
+"""The fused tick kernel: the ingest->schedule span as ONE ``pallas_call``.
+
+Why this exists (ROADMAP item 5): the tick is memory/latency-bound — the
+round-5 TPU roofline record (tools/cost_probe_tpu_r05.json) puts the
+headline FIFO tick at ~0.10 FLOP/byte, and the profile plane's
+phase-prefix ablation attributes most of it to the schedule pass. Under
+XLA the tick is a chain of fusions that round-trips the queue/runset/node
+columns through HBM between phases: each phase's fusion loads the state
+columns from its argument buffers and stores them back at its output
+boundary. This kernel collapses the hottest CONTIGUOUS, PER-CLUSTER span —
+phase 4 (arrival ingest) + phase 5 (the policy zoo's scheduling pass) —
+into one ``pallas_call`` over cluster blocks: each grid step loads its
+block's columns ONCE, runs the whole span over the VMEM-resident values,
+and writes each column back ONCE. ``tools/cost_probe.py --fused`` measures
+exactly that collapse (per-phase executable boundary bytes vs the fused
+executable's), and ``bench.py --fused ab`` is the standing bitwise + bytes
+gate.
+
+Bit-identity is BY CONSTRUCTION, not by porting: the kernel body calls
+``Engine._span_ingest_schedule`` — the same function the unfused path
+runs — on the block-resident values. Blocking the cluster axis is bitwise
+invisible because every op in the span is per-cluster (vmapped); the block
+size is the largest divisor of the (shard-local) cluster count <= the
+``fused_block`` hint, so no block is ever padded.
+
+Layout-generic over the PR-5 compact plan by the same construction: the
+kernel refs carry each leaf's STORAGE dtype (int8/int16 queue columns
+under a CompactPlan), the span's queue ops widen on load through the SoA
+accessors and narrow on store through the checked ``fields.narrow_store``
+helper inside the kernel body, and the ``ovf`` overflow counters ride the
+block like any other column — counting preserved exactly.
+
+The interpret-mode oracle: ``pallas_call(interpret=True)`` executes the
+same kernel body through XLA on any backend, so the ENTIRE existing
+bit-equality matrix (compact x time compression x ragged chunks x faults x
+the 8-device mesh x checkpoint cuts) gates the kernel on CPU CI today
+(tests/test_kernels.py); a real TPU backend compiles the same body via
+Mosaic and is gated by the same tests' interpret-vs-compiled cells.
+``interpret=`` is ALWAYS threaded from config (``interpret_mode`` below) —
+simlint rule family 10 rejects hardcoding it at any ``pallas_call`` site.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# The fused phase span (contiguous obs.profile.TICK_PHASES members; both
+# per-cluster-local, which is what makes them blockable). Recorded in
+# every provenance dict so artifacts name the span they measured.
+FUSED_SPAN = ("ingest", "schedule")
+
+
+def interpret_mode(cfg) -> bool:
+    """The ``pallas_call(interpret=...)`` source of truth: config first
+    (``fused_interpret`` pins it for tests and A/Bs), else interpret
+    everywhere except a real TPU backend — the CPU/CI-oracle contract.
+    Every call site threads this; simlint family 10 enforces it."""
+    if cfg.fused_interpret is not None:
+        return bool(cfg.fused_interpret)
+    return jax.default_backend() != "tpu"
+
+
+def is_active(cfg) -> bool:
+    """Resolve ``cfg.fused`` to a concrete engage/skip decision (the one
+    definition Engine.fused_active and the bench/probe drivers share):
+    ``on`` always, ``auto`` only on a real TPU backend — interpret mode
+    is an oracle, not a fast path, so CPU hosts stay unfused unless the
+    config pins ``on``."""
+    if cfg.fused == "on":
+        return True
+    if cfg.fused == "auto":
+        return jax.default_backend() == "tpu"
+    return False
+
+
+def block_clusters(C: int, hint: int) -> int:
+    """Largest divisor of ``C`` that is <= ``hint`` (>=1): the cluster
+    block each grid step owns. A divisor, never a ceiling — padded blocks
+    would feed garbage rows into the span's sorts and need masked stores;
+    a divisor keeps blocking bitwise invisible by construction."""
+    bc = max(min(C, hint), 1)
+    while C % bc:
+        bc -= 1
+    return bc
+
+
+def provenance(cfg, C: int | None = None) -> dict:
+    """The ``fused`` provenance fields bench/probe detail dicts record
+    (host-side; the engage decision re-resolves from config here)."""
+    act = is_active(cfg)
+    out = {"mode": cfg.fused, "active": act, "span": list(FUSED_SPAN)}
+    if act:
+        out["interpret"] = interpret_mode(cfg)
+        out["block_hint"] = cfg.fused_block
+        if C is not None:
+            out["block_clusters"] = block_clusters(C, cfg.fused_block)
+    return out
+
+
+def _specs_for(shapes, per_cluster, bc):
+    """One BlockSpec per leaf: per-cluster leaves block axis 0 into
+    ``bc``-cluster slices (the grid axis); replicated leaves (the clock,
+    the PolicyParams tables) load whole into every grid step."""
+    specs = []
+    for shape, pc in zip(shapes, per_cluster):
+        nd = len(shape)
+        # simlint: ignore[pallas-kernel] -- host-side spec construction:
+        # `pc` is a Python bool from the static per-leaf layout table,
+        # never a tracer (shapes/flags are decided before tracing)
+        if pc:
+            specs.append(pl.BlockSpec(
+                (bc,) + tuple(shape[1:]),
+                lambda i, _nd=nd: (i,) + (0,) * (_nd - 1)))
+        else:
+            specs.append(pl.BlockSpec(
+                tuple(shape), lambda i, _nd=nd: (0,) * _nd))
+    return specs
+
+
+def fused_span(engine, state, arr_rows, arr_n, t, params, tick_indexed):
+    """Run ``Engine._span_ingest_schedule`` (tick phases 4+5) as one
+    ``pallas_call`` over cluster blocks. Same signature contract as the
+    unfused call: returns ``(state', want, bjob_vec)``.
+
+    Ref discipline (simlint family 10): every input is read exactly once
+    into block values (``ref[...]``), the span runs on those values, and
+    every output is written exactly once — one load + one store per
+    column, which is the whole point of the kernel.
+
+    The span is traced to a jaxpr FIRST (at block shape) and replayed
+    inside the kernel body: the span's closure constants (queue invalid
+    rows, policy dispatch tables — module-level arrays Pallas cannot
+    capture) become explicit replicated kernel operands, so the body is a
+    pure function of its refs for ANY policy set or state layout."""
+    from multi_cluster_simulator_tpu.ops import queues as Q
+
+    cfg = engine.cfg
+    C = int(state.arr_ptr.shape[0])
+    bc = block_clusters(C, cfg.fused_block)
+    interp = interpret_mode(cfg)
+
+    # --- flatten the operands ------------------------------------------
+    # State: every leaf is [C]-leading except the scalar clock (STATE_AXES
+    # broadcasts exactly one leaf: ``t``); the clock rides as a replicated
+    # (1,)-shaped operand and is re-inserted at its flatten position
+    # inside the span, so it sees a structurally identical SimState.
+    s_leaves, s_def = jax.tree_util.tree_flatten(state)
+    t_pos = [i for i, leaf in enumerate(s_leaves)
+             if jnp.ndim(leaf) == 0]
+    if len(t_pos) != 1:
+        raise ValueError(
+            f"fused_span expects exactly one scalar state leaf (the "
+            f"clock); got {len(t_pos)} — did SimState grow a scalar?")
+    t_pos = t_pos[0]
+    t_old = s_leaves.pop(t_pos)
+    p_leaves, p_def = jax.tree_util.tree_flatten(params)
+    p_shapes = [jnp.shape(leaf) for leaf in p_leaves]
+
+    def lift(x):  # scalars -> (1,) so every operand is an array block
+        return jnp.reshape(x, (1,)) if jnp.ndim(x) == 0 else x
+
+    data_in = (list(s_leaves) + [arr_rows, arr_n]
+               + [lift(t_old), lift(t)] + [lift(x) for x in p_leaves])
+    data_pc = ([True] * len(s_leaves) + [True, True]
+               + [False, False] + [False] * len(p_leaves))
+    n_state = len(s_leaves)
+
+    def span_flat(*flat):
+        sv = list(flat[:n_state])
+        rows_b, n_b, t_old_b, t_new_b = flat[n_state:n_state + 4]
+        pv = flat[n_state + 4:]
+        sv.insert(t_pos, jnp.reshape(t_old_b, ()))
+        s_b = jax.tree_util.tree_unflatten(s_def, sv)
+        p_b = jax.tree_util.tree_unflatten(
+            p_def, [jnp.reshape(v, sh) for v, sh in zip(pv, p_shapes)])
+        s2, want, bjob = engine._span_ingest_schedule(
+            s_b, rows_b, n_b, jnp.reshape(t_new_b, ()), p_b, tick_indexed)
+        o_leaves = jax.tree_util.tree_leaves(s2)
+        del o_leaves[t_pos]  # the clock is untouched by the span
+        return tuple(o_leaves) + (want, bjob)
+
+    def block_shape(x, pc):
+        shape = jnp.shape(x)
+        return ((bc,) + tuple(shape[1:])) if pc else tuple(shape)
+
+    abstract = [jax.ShapeDtypeStruct(block_shape(x, pc), x.dtype)
+                for x, pc in zip(data_in, data_pc)]
+    closed = jax.make_jaxpr(span_flat)(*abstract)
+    # closure constants -> replicated operands (scalars lifted like t)
+    consts = [jnp.asarray(c) for c in closed.consts]
+    c_shapes = [jnp.shape(c) for c in consts]
+
+    inputs = data_in + [lift(c) for c in consts]
+    per_cluster = data_pc + [False] * len(consts)
+    in_specs = _specs_for([jnp.shape(x) for x in inputs], per_cluster, bc)
+
+    # Outputs: the per-cluster state leaves (same order/dtypes — the span
+    # preserves storage dtypes, compact plans included) plus the schedule
+    # pass's borrow outputs. The clock stays an input.
+    out_tmpl = [jax.ShapeDtypeStruct(jnp.shape(x), x.dtype)
+                for x in s_leaves]
+    out_tmpl += [jax.ShapeDtypeStruct((C,), jnp.bool_),
+                 jax.ShapeDtypeStruct((C, Q.NF), jnp.int32)]
+    out_specs = _specs_for([s.shape for s in out_tmpl],
+                           [True] * len(out_tmpl), bc)
+
+    n_data = len(data_in)
+
+    def body(*refs):
+        ins, outs = refs[:len(inputs)], refs[len(inputs):]
+        vals = [r[...] for r in ins]  # ONE load per column
+        cvals = [jnp.reshape(v, sh)
+                 for v, sh in zip(vals[n_data:], c_shapes)]
+        out_vals = jax.core.eval_jaxpr(closed.jaxpr, cvals,
+                                       *vals[:n_data])
+        for ref, val in zip(outs, out_vals):
+            ref[...] = val  # ONE store per column
+
+    outs = pl.pallas_call(
+        body,
+        grid=(C // bc,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_tmpl,
+        interpret=interp,
+    )(*inputs)
+
+    new_leaves = list(outs[:n_state])
+    new_leaves.insert(t_pos, t_old)
+    state2 = jax.tree_util.tree_unflatten(s_def, new_leaves)
+    return state2, outs[n_state], outs[n_state + 1]
+
+
+def span_boundary_bytes(cfg, state, arr_rows, arr_n,
+                        tick_indexed: bool = True) -> dict:
+    """The before/after instrument for the span collapse (compile-only;
+    nothing runs): each span phase compiled as its OWN executable pays
+    argument+output buffer-boundary traffic for the state columns it
+    touches — that per-phase sum (``unfused_total``) against the ONE
+    fused-span executable's boundary bytes (``fused``) is the measured
+    form of "one load + one store per column". ``tools/cost_probe.py
+    --fused`` records it per shape and ``bench.py --fused ab`` gates on
+    ``fused < unfused_total`` strictly.
+
+    ``state`` may be narrow (compact plan): the node columns are widened
+    here exactly as the tick-entry widen would, so the executables match
+    the mid-tick state the real span receives."""
+    import dataclasses
+
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.ops import fields as F
+
+    eng = Engine(dataclasses.replace(cfg, fused="off"))
+    eng_f = Engine(dataclasses.replace(cfg, fused="on"))
+    params = eng._default_params
+    if state.node_free.dtype != jnp.int32:
+        state = state.replace(node_free=F.widen(state.node_free),
+                              node_cap=F.widen(state.node_cap))
+    t1 = state.t + cfg.tick_ms
+
+    def bbytes(fn):
+        ma = jax.jit(fn).lower(state, arr_rows, arr_n,
+                               t1).compile().memory_analysis()
+        # simlint: ignore[pallas-kernel] -- host-side compile-time probe:
+        # memory_analysis returns plain Python stats on an already-
+        # compiled executable, never a tracer (nothing here is traced)
+        return int(ma.argument_size_in_bytes + ma.output_size_in_bytes)
+
+    def phase_ingest(s, rows, cnt, tt):
+        return eng._span_ingest_schedule(s, rows, cnt, tt, params,
+                                         tick_indexed, do_ingest=True,
+                                         do_schedule=False)[0]
+
+    def phase_schedule(s, rows, cnt, tt):
+        return eng._span_ingest_schedule(s, rows, cnt, tt, params,
+                                         tick_indexed, do_ingest=False,
+                                         do_schedule=True)
+
+    def span(s, rows, cnt, tt):
+        return fused_span(eng_f, s, rows, cnt, tt, params, tick_indexed)
+
+    per_phase = {"ingest": bbytes(phase_ingest),
+                 "schedule": bbytes(phase_schedule)}
+    fused = bbytes(span)
+    total = sum(per_phase.values())
+    return {"unfused_per_phase": per_phase, "unfused_total": total,
+            "fused": fused,
+            "reduction": round(1.0 - fused / max(total, 1), 4)}

@@ -1,0 +1,36 @@
+"""Known-bad pallas kernel module — five distinct shapes the family must
+catch: a ref touched through an attribute/method (bypassing the block
+indexing discipline), a wall-clock read inside the kernel body, a traced
+branch in the body, a pallas_call with NO interpret kwarg, and a
+pallas_call hardcoding interpret=False."""
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _body(x_ref, o_ref):
+    m = x_ref.mean()  # BAD: ref attribute access, not block indexing
+    x = x_ref[...]
+    if x[0] > 0:  # BAD: traced branch inside the kernel body
+        x = x + 1
+    jitter = time.time()  # BAD: wall-clock inside a kernel
+    o_ref[...] = x + jnp.float32(jitter) + m
+
+
+def call_missing_interpret(x):
+    return pl.pallas_call(  # BAD: no interpret= kwarg
+        _body,
+        grid=(1,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def call_hardcoded_false(x):
+    return pl.pallas_call(
+        _body,
+        grid=(1,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=False,  # BAD: hardcoded — never threads from config
+    )(x)

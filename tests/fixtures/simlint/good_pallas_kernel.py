@@ -1,0 +1,29 @@
+"""Paired clean kernel module: refs touched only through block indexing,
+no host state in the body, and every pallas_call threads ``interpret=``
+from config (a variable derived from ``interpret_mode``, never a literal
+``False``)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def interpret_mode(cfg):
+    if cfg.fused_interpret is not None:
+        return bool(cfg.fused_interpret)
+    return jax.default_backend() != "tpu"
+
+
+def _body(x_ref, o_ref):
+    x = x_ref[...]  # ONE load
+    y = jnp.where(x > 0, x + 1, x)
+    o_ref[...] = y  # ONE store
+
+
+def call(cfg, x):
+    interp = interpret_mode(cfg)
+    return pl.pallas_call(
+        _body,
+        grid=(1,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interp,
+    )(x)

@@ -1,0 +1,317 @@
+"""The fused tick kernel (kernels/fused_tick.py): the Pallas
+ingest->schedule span, gated by the interpret-mode oracle, must be
+bit-identical to the unfused XLA tick across the full parity matrix —
+DELAY parity/blocked/wave+trader, FFD, FIFO+borrowing, the gavel/tesserae
+scored sweeps — composed with the compact layout, event-compressed time,
+the ragged chunk pipeline, the fault plane, the 8-device mesh, and a
+checkpoint cut inside a fused run; and the checked-narrow overflow
+counting must be preserved through the kernel path
+(ARCHITECTURE.md §fused tick kernel, PARITY.md §fused kernel)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+from multi_cluster_simulator_tpu.core import checkpoint as ckpt
+from multi_cluster_simulator_tpu.core import compact as CC
+from multi_cluster_simulator_tpu.core.engine import (
+    Engine, pack_arrivals_by_tick, pack_arrivals_chunks,
+)
+from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+from multi_cluster_simulator_tpu.core.state import init_state
+from multi_cluster_simulator_tpu.kernels import fused_tick
+from multi_cluster_simulator_tpu.policies import PolicySet
+from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+from tests.test_pipeline import (
+    TC_TICKS, TICK_MS, _assert_trees_equal, _bursty_arrivals, _cfg, _specs,
+    _tc_scenarios,
+)
+
+# a small hint so every matrix cell exercises REAL multi-block grids (the
+# scenarios run 1-2 clusters; bit-equality must not depend on blocking)
+FUSED = dict(fused="on", fused_block=1)
+
+
+def _fused(cfg, **kw):
+    return dataclasses.replace(cfg, **{**FUSED, **kw})
+
+
+# --------------------------------------------------------------------------
+# block geometry
+# --------------------------------------------------------------------------
+
+def test_block_clusters_is_a_divisor_at_or_under_the_hint():
+    for C in (1, 2, 3, 4, 7, 8, 96, 256, 4096):
+        for hint in (1, 2, 3, 64, 256, 10_000):
+            bc = fused_tick.block_clusters(C, hint)
+            assert C % bc == 0 and 1 <= bc <= max(min(C, hint), 1), (C, hint)
+
+
+def test_fused_provenance_names_the_span():
+    cfg = _fused(_cfg())
+    prov = Engine(cfg).fused_provenance()
+    assert prov["mode"] == "on" and prov["active"]
+    assert prov["span"] == ["ingest", "schedule"]
+    assert prov["interpret"] is True  # the CPU/CI oracle contract
+
+
+# --------------------------------------------------------------------------
+# the policy parity matrix (same scenarios the compression/compact claims
+# are pinned on), plus the scored-sweep zoo members
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(_tc_scenarios()))
+def test_fused_bit_identical_across_policy_matrix(name):
+    cfg, arr, specs = _tc_scenarios()[name]
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    ref = Engine(cfg).run_jit()(init_state(cfg, specs), ta, TC_TICKS)
+    out = Engine(_fused(cfg)).run_jit()(init_state(cfg, specs), ta,
+                                        TC_TICKS)
+    _assert_trees_equal(ref, out)
+    state = ref[0] if isinstance(ref, tuple) else ref
+    assert int(np.asarray(state.placed_total).sum()) > 0
+
+
+@pytest.mark.parametrize("policy", ["gavel", "tesserae", "rl"])
+def test_fused_bit_identical_scored_sweeps(policy):
+    """The heterogeneity/packing zoo members ride Gavel's scored-sweep
+    path (f32 score matrices) — float ops must fuse bit-exactly too."""
+    C, n_ticks = 4, 30
+    cfg = SimConfig(policy=PolicyKind.DELAY, parity=False, queue_capacity=32,
+                    max_running=64, max_arrivals=64,
+                    max_placements_per_tick=8, n_res=3, max_nodes=5,
+                    max_virtual_nodes=0)
+    specs = [uniform_cluster(c + 1, 5, gpus=8 if c % 2 == 0 else 0)
+             for c in range(C)]
+    arr = uniform_stream(C, 24, n_ticks * cfg.tick_ms, max_cores=8,
+                         max_mem=6_000, max_dur_ms=20_000, seed=3,
+                         max_gpus=2, gpu_frac=0.2)
+    ta = pack_arrivals_by_tick(arr, n_ticks, cfg.tick_ms)
+    pset = PolicySet((policy,))
+    p = pset.params_for(cfg)
+    state = init_state(cfg, specs)
+    ref = Engine(cfg, policies=pset).run_jit()(state, ta, n_ticks, p)
+    out = Engine(_fused(cfg, fused_block=2),
+                 policies=pset).run_jit()(state, ta, n_ticks, p)
+    _assert_trees_equal(ref, out)
+    assert int(np.asarray(ref.placed_total).sum()) > 0
+
+
+# --------------------------------------------------------------------------
+# compositions: compact x compression x ragged chunks x faults x mesh
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["delay_parity", "fifo_borrowing"])
+def test_fused_compact_equals_unfused_wide(name):
+    """The strongest cross-claim: the fused kernel over COMPACT narrow
+    storage must equal the unfused WIDE tick — layout-genericity (widen
+    on load, checked-narrow on store inside the kernel) and the span
+    fusion verified against one reference."""
+    cfg, arr, specs = _tc_scenarios()[name]
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    ref = Engine(cfg).run_jit()(init_state(cfg, specs), ta, TC_TICKS)
+    plan = CC.derive_plan(cfg, specs, arr)
+    out = Engine(_fused(cfg)).run_jit()(
+        init_state(cfg, specs, plan=plan), ta, TC_TICKS)
+    assert CC.overflow_total(out[0]) == 0
+    _assert_trees_equal(ref[0], CC.to_wide(out[0]))
+    _assert_trees_equal(ref[1], out[1])  # the metric series too
+
+
+def test_fused_composes_with_time_compression():
+    """The leap driver over a fused tick body: quiescence fingerprints,
+    leaps, and the reconstructed series all bit-equal the unfused dense
+    scan — and the driver still actually leaps."""
+    cfg, arr, specs = _tc_scenarios()["delay_parity"]
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    ref, ref_series = Engine(cfg).run_jit()(init_state(cfg, specs), ta,
+                                            TC_TICKS)
+    out, series, stats = Engine(_fused(cfg)).run_compressed_jit()(
+        init_state(cfg, specs), ta, TC_TICKS)
+    _assert_trees_equal(ref, out)
+    _assert_trees_equal(ref_series, series)
+    assert int(np.asarray(stats.ticks_executed)) < TC_TICKS, \
+        "compression never leapt — vacuous compose test"
+
+
+def test_fused_chunked_across_ragged_k_boundary():
+    """Fused + the streamed chunk pipeline (ragged per-chunk K, donated
+    state) equals the unfused one-scan run across a K boundary."""
+    C = 3
+    arr = _bursty_arrivals(C)
+    cfg = _cfg()
+    chunks = [10, 10]
+    ta = pack_arrivals_by_tick(arr, sum(chunks), TICK_MS)
+    ref = Engine(cfg).run_jit()(init_state(cfg, _specs(C)), ta, sum(chunks))
+
+    parts = pack_arrivals_chunks(arr, chunks, TICK_MS)
+    assert parts[0].rows.shape[2] != parts[1].rows.shape[2]
+    jfn = Engine(_fused(cfg)).run_jit(donate=True)
+    s = jax.tree.map(jnp.copy, init_state(cfg, _specs(C)))
+    for part, n in zip(parts, chunks):
+        s = jfn(s, jax.device_put(part), n)
+    _assert_trees_equal(ref, jax.block_until_ready(s))
+
+
+def test_fused_composes_with_faults():
+    """The fault phase (before the span) feeds kill/requeue state through
+    the kernel; generative churn must stay bit-identical fused."""
+    cfg = _cfg()
+    cfg = dataclasses.replace(cfg, faults=dataclasses.replace(
+        cfg.faults, enabled=True, mttf_ms=8_000, mttr_ms=3_000))
+    C, n_ticks = 3, 30
+    arr = _bursty_arrivals(C)
+    ta = pack_arrivals_by_tick(arr, n_ticks, TICK_MS)
+    ref = Engine(cfg).run_jit()(init_state(cfg, _specs(C)), ta, n_ticks)
+    out = Engine(_fused(cfg)).run_jit()(init_state(cfg, _specs(C)), ta,
+                                        n_ticks)
+    _assert_trees_equal(ref, out)
+    assert int(np.asarray(ref.faults.kills).sum()) > 0, \
+        "no node ever failed — vacuous faults compose test"
+
+
+def test_fused_sharded_bit_identical_to_unfused_local():
+    """The kernel inside shard_map over the 8-device mesh (block size 1 on
+    each shard's local clusters) equals the single-device unfused run."""
+    from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
+
+    C = 8
+    arr = _bursty_arrivals(C)
+    cfg = _cfg()
+    ta = pack_arrivals_by_tick(arr, 20, TICK_MS)
+    ref = Engine(cfg).run_jit()(init_state(cfg, _specs(C)), ta, 20)
+
+    sh = ShardedEngine(_fused(cfg), make_mesh(8))
+    s = sh.shard_state(init_state(cfg, _specs(C)))
+    out = sh.run_fn(20, tick_indexed=True)(s, sh.shard_arrivals(ta))
+    _assert_trees_equal(ref, jax.block_until_ready(out))
+
+
+def test_fused_run_io_matches_unfused_events():
+    """The serving tier's dispatch unit: run_io fused must emit identical
+    states AND identical stacked TickIO events (borrow wants + finished-
+    foreign returns cross the kernel boundary as outputs)."""
+    cfg, arr, specs = _tc_scenarios()["fifo_borrowing"]
+    cfg = dataclasses.replace(cfg, record_metrics=False)
+    ta = pack_arrivals_by_tick(arr, 30, cfg.tick_ms)
+    s0 = init_state(cfg, specs)
+    ref_s, ref_io = Engine(cfg).run_io_jit()(s0, ta.rows[:30],
+                                             ta.counts[:30])
+    out_s, out_io = Engine(_fused(cfg)).run_io_jit()(s0, ta.rows[:30],
+                                                     ta.counts[:30])
+    _assert_trees_equal(ref_s, out_s)
+    _assert_trees_equal(ref_io, out_io)
+    assert bool(np.asarray(ref_io.borrow_want).any()), \
+        "no borrow event crossed the kernel boundary — vacuous io test"
+
+
+# --------------------------------------------------------------------------
+# checkpoint cut inside a fused run; strategy fields invisible to resume
+# --------------------------------------------------------------------------
+
+def test_checkpoint_cut_inside_fused_run(tmp_path):
+    """Save at tick 40 of a fused run, reload, finish fused: bit-identical
+    to the uninterrupted fused run AND to the uninterrupted unfused run."""
+    cfg, arr, specs = _tc_scenarios()["delay_parity"]
+    cfg = dataclasses.replace(cfg, record_metrics=False)
+    fcfg = _fused(cfg)
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    ref = Engine(cfg).run_jit()(init_state(cfg, specs), ta, TC_TICKS)
+    straight = Engine(fcfg).run_jit()(init_state(cfg, specs), ta, TC_TICKS)
+
+    eng = Engine(fcfg)
+    half = eng.run_jit()(init_state(cfg, specs),
+                         pack_arrivals_by_tick(arr, 40, cfg.tick_ms), 40)
+    path = str(tmp_path / "fused_cut.ckpt")
+    ckpt.save_state(half, path, cfg=fcfg)
+    loaded = ckpt.load_state(path, init_state(cfg, specs), cfg=fcfg)
+    rest = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    from multi_cluster_simulator_tpu.core.state import TickArrivals
+    tail = TickArrivals(rows=rest.rows[40:], counts=rest.counts[40:])
+    out = eng.run_jit()(loaded, tail, TC_TICKS - 40)
+    _assert_trees_equal(straight, out)
+    _assert_trees_equal(ref, out)
+
+
+def test_fused_flag_is_invisible_to_checkpoint_headers(tmp_path):
+    """The fused switch is execution strategy, not semantics: a checkpoint
+    written by an unfused run must load under a fused engine's config (and
+    vice versa) — the header digest excludes the strategy fields, so long
+    runs can flip the kernel on mid-life (core/checkpoint.config_describe)."""
+    cfg, arr, specs = _tc_scenarios()["delay_parity"]
+    cfg = dataclasses.replace(cfg, record_metrics=False)
+    fcfg = _fused(cfg)
+    assert ckpt.config_digest(cfg) == ckpt.config_digest(fcfg)
+    s = init_state(cfg, specs)
+    path = str(tmp_path / "strategy.ckpt")
+    ckpt.save_state(s, path, cfg=cfg)
+    ckpt.load_state(path, s, cfg=fcfg)  # must not raise
+    # a REAL config change must still be caught
+    other = dataclasses.replace(fcfg, max_wait_ms=cfg.max_wait_ms + 1)
+    with pytest.raises(ValueError, match="max_wait_ms"):
+        ckpt.load_state(path, s, cfg=other)
+
+
+# --------------------------------------------------------------------------
+# narrow-store overflow counting preserved through the kernel path
+# --------------------------------------------------------------------------
+
+def test_fused_preserves_narrow_overflow_counting():
+    """An UNDERSIZED queue dtype (int8 cores against a 500-core stream)
+    must count into ovf identically through the fused kernel — the
+    checked-narrow store runs INSIDE the kernel body, never wraps, and
+    the fused/unfused counters match bit for bit."""
+    from multi_cluster_simulator_tpu.core.state import Arrivals
+    from multi_cluster_simulator_tpu.ops import fields as F
+
+    cfg = _cfg()
+    C, A = 1, 4
+    arr = Arrivals(
+        t=np.asarray([[1_500, 2_500, 3_500, 4_500]], np.int32),
+        id=np.arange(A, dtype=np.int32).reshape(1, A),
+        cores=np.asarray([[500, 2, 500, 2]], np.int32),  # 500 > int8 max
+        mem=np.full((1, A), 100, np.int32),
+        gpu=np.zeros((1, A), np.int32),
+        dur=np.full((1, A), 5_000, np.int32),
+        n=np.full((1,), A, np.int32))
+    plan = CC.derive_plan(cfg, _specs(C), arrivals=None)
+    undersized = dataclasses.replace(
+        plan, queue=tuple((n, "int8" if n == "cores" else dt)
+                          for n, dt in plan.queue))
+    ta = pack_arrivals_by_tick(arr, 10, TICK_MS)
+    ref = Engine(cfg).run_jit()(
+        init_state(cfg, _specs(C), plan=undersized), ta, 10)
+    out = Engine(_fused(cfg)).run_jit()(
+        init_state(cfg, _specs(C), plan=undersized), ta, 10)
+    _assert_trees_equal(ref, out)
+    assert CC.overflow_total(out) > 0, (
+        "the 500-core rows never overflowed int8 — vacuous ovf test")
+    # clamped to the dtype minimum (deterministic poison), never wrapped
+    stored = np.asarray(out.ready.f_cores)
+    assert not (stored == 500 % 256).any()
+
+
+# --------------------------------------------------------------------------
+# interpret-vs-compiled (a real TPU backend only)
+# --------------------------------------------------------------------------
+
+def test_interpret_equals_compiled_on_tpu():
+    """Where a real TPU backend is attached, the Mosaic-compiled kernel
+    must equal the interpret-mode oracle bit for bit on the headline
+    span. Skipped elsewhere: interpret mode IS the only executable form
+    of the kernel on CPU hosts, so there is no second path to compare."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("no real TPU backend attached: the compiled "
+                    "(Mosaic) kernel path cannot lower on this host — "
+                    "interpret mode is the only executable form here")
+    cfg, arr, specs = _tc_scenarios()["delay_parity"]
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    oracle = Engine(_fused(cfg, fused_interpret=True)).run_jit()(
+        init_state(cfg, specs), ta, TC_TICKS)
+    compiled = Engine(_fused(cfg, fused_interpret=False)).run_jit()(
+        init_state(cfg, specs), ta, TC_TICKS)
+    _assert_trees_equal(oracle, compiled)

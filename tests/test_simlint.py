@@ -69,6 +69,7 @@ FIXTURE_RULES = [
     ("bad_det_chunk_sync.py", "det-chunk-sync"),
     ("bad_compact_store.py", "compact-store"),
     ("bad_policy_kernel.py", "policy-kernel"),
+    ("bad_pallas_kernel.py", "pallas-kernel"),
     ("bad_env_rng.py", "env-rng"),
     ("bad_shard_exchange.py", "shard-exchange"),
     ("bad_serve_sync.py", "serve-sync"),
@@ -174,6 +175,53 @@ def test_policy_kernel_scopes_the_kernels_module():
     modules, _ = load_target(str(PKG_DIR))
     assert any(m.relpath in POLICY_KERNEL_FILES for m in modules), \
         "policies/kernels.py not loaded — the policy-kernel scope is empty"
+
+
+def test_bad_pallas_kernel_flags_every_violation_shape():
+    """The fixture carries five shapes — a ref touched through an
+    attribute (block-indexing bypass), a traced branch inside the kernel
+    body, a wall-clock read in the body, a pallas_call with no interpret=
+    kwarg, and a pallas_call hardcoding interpret=False — and each must
+    surface as its own pallas-kernel finding."""
+    findings = [f for f in run(str(FIXTURES / "bad_pallas_kernel.py"))
+                if f.rule == "pallas-kernel"]
+    assert len(findings) == 5, "\n".join(f.render() for f in findings)
+
+
+def test_good_pallas_kernel_fixture_is_clean():
+    """The paired clean kernel — block-indexed ref reads/writes only, and
+    interpret= threaded from a config-derived variable — must NOT trip
+    pallas-kernel (or anything else)."""
+    findings = run(str(FIXTURES / "good_pallas_kernel.py"))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    proc = _cli(str(FIXTURES / "good_pallas_kernel.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_pallas_kernel_reaches_the_real_kernel(tmp_path):
+    """pallas-kernel provably engages with kernels/fused_tick.py's real
+    code: hardcode the interpret flag to False at the real pallas_call
+    site and the rule must fire — so the package analyzing clean can never
+    mean 'checked nothing'."""
+    src = (PKG_DIR / "kernels" / "fused_tick.py").read_text()
+    anchor = "        interpret=interp,\n"
+    bad = src.replace(anchor, "        interpret=False,\n", 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "fused_tick_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "pallas-kernel" for x in run(str(f)))
+
+
+def test_pallas_kernel_scopes_the_kernels_package():
+    """The family actually runs over kernels/ inside the package (a clean
+    result must mean 'checked and clean', not 'not in scope')."""
+    from tools.simlint.runner import PALLAS_KERNEL_DIRS
+
+    modules, _ = load_target(str(PKG_DIR))
+    scoped = [m for m in modules
+              if m.relpath.split("/", 1)[0] in PALLAS_KERNEL_DIRS]
+    assert any(m.relpath == "kernels/fused_tick.py" for m in scoped), \
+        "kernels/fused_tick.py not loaded — the pallas-kernel scope is empty"
 
 
 def test_bad_env_rng_flags_every_violation_shape():

@@ -167,7 +167,66 @@ def probe_layout(cfg, n_ticks, specs, arr, plan):
     return out_row
 
 
-def probe(name, cfg, C, jobs_per, full_ticks, n_ticks=200, compact="both"):
+def probe_fused_span(cfg, n_ticks, specs, arr, plan):
+    """The fused-kernel instrument (kernels/fused_tick.py): measure the
+    SPAN-level buffer-boundary collapse the kernel exists for, plus the
+    fused full-tick wall as the same scanned-run timing the layout rows
+    use.
+
+    Under XLA the ingest->schedule span is separate computations whose
+    queue/runset/node columns cross a buffer boundary PER PHASE; fused,
+    each column crosses once (one load + one store). The instrument makes
+    that concrete: each span phase is compiled as its own executable and
+    its argument+output bytes summed (``unfused_total`` — the per-phase
+    boundary traffic), against the ONE fused-span executable's
+    argument+output bytes (``fused``). The gate (``_check``) requires the
+    fused number strictly lower. ``plan`` should be the layout the
+    comparison rows measured (compact when available — the acceptance
+    bar is "below the compact unfused tick", not the easy wide one)."""
+    import dataclasses
+
+    import jax
+
+    from multi_cluster_simulator_tpu.core.engine import (
+        Engine, pack_arrivals_by_tick,
+    )
+    from multi_cluster_simulator_tpu.core.state import init_state
+    from multi_cluster_simulator_tpu.kernels import fused_tick
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+
+    cfg_f = dataclasses.replace(cfg, fused="on")
+    eng_f = Engine(cfg_f)
+    state = init_state(cfg, specs, plan=plan)
+    ta = pack_arrivals_by_tick(arr, n_ticks, cfg.tick_ms)
+    rows0 = jax.device_put(ta.rows[0])
+    cnt0 = jax.device_put(ta.counts[0])
+
+    out = eng_f.fused_provenance()
+    out["block_clusters"] = fused_tick.block_clusters(
+        state.arr_ptr.shape[0], cfg.fused_block)
+    try:
+        out["span_bytes"] = fused_tick.span_boundary_bytes(
+            cfg, state, rows0, cnt0, tick_indexed=True)
+    except Exception as e:  # jax builds without Compiled.memory_analysis
+        out["span_bytes_note"] = (f"memory_analysis unavailable "
+                                  f"({type(e).__name__}); span gate skipped")
+
+    # fused full-tick wall, same scanned-run methodology as probe_layout
+    f = eng_f.run_jit()
+    run_out = jax.block_until_ready(f(state, ta, n_ticks))
+    walls = []
+    for _ in range(3):
+        t0 = time.time()
+        run_out = jax.block_until_ready(f(state, ta, n_ticks))
+        walls.append(time.time() - t0)
+    out["measured_ms_per_tick"] = round(min(walls) / n_ticks * 1e3, 3)
+    out["placed"] = int(np.asarray(run_out.placed_total).sum())
+    out["drops"] = total_drops(run_out)
+    return out
+
+
+def probe(name, cfg, C, jobs_per, full_ticks, n_ticks=200, compact="both",
+          fused="off"):
     import dataclasses
 
     import jax
@@ -188,6 +247,7 @@ def probe(name, cfg, C, jobs_per, full_ticks, n_ticks=200, compact="both"):
                          gpu_frac=0.1 if cfg.n_res > 2 else 0.0)
     row = {"config": name, "clusters": C, "backend": jax.default_backend(),
            "device": jax.devices()[0].device_kind}
+    plan = None
     if compact != "on":
         row.update(probe_layout(cfg, n_ticks, specs, arr, plan=None))
     if compact != "off":
@@ -205,6 +265,10 @@ def probe(name, cfg, C, jobs_per, full_ticks, n_ticks=200, compact="both"):
                 crow["state_bytes_reduction"] = round(
                     1.0 - crow["state_bytes"] / row["state_bytes"], 4)
             row["compact"] = crow
+    if fused == "on":
+        # the fused row rides the best unfused layout measured (compact
+        # when available) — the acceptance bar for the kernel
+        row["fused"] = probe_fused_span(cfg, n_ticks, specs, arr, plan)
     return row
 
 
@@ -212,6 +276,27 @@ def _check(rows, compact) -> list[str]:
     """Degenerate-measurement audit: the reasons this CLI exits nonzero."""
     problems = []
     for r in rows:
+        fd = r.get("fused")
+        if fd is not None:
+            sb = fd.get("span_bytes")
+            if sb is None:
+                if "span_bytes_note" not in fd:
+                    problems.append(f"{r['config']}: fused row carries no "
+                                    "span_bytes measurement")
+            elif sb["fused"] >= sb["unfused_total"]:
+                problems.append(
+                    f"{r['config']}: fused span streams MORE buffer-boundary "
+                    f"bytes than the per-phase executables "
+                    f"({sb['fused']} >= {sb['unfused_total']}) — the kernel "
+                    "stopped collapsing the span")
+            base = r.get("compact") or r
+            if fd.get("placed") != base.get("placed"):
+                problems.append(
+                    f"{r['config']}: fused placed {fd.get('placed')} != "
+                    f"unfused {base.get('placed')} — the kernel diverged")
+            if fd.get("drops") and any(fd["drops"].values()):
+                problems.append(
+                    f"{r['config']}[fused]: nonzero drops {fd['drops']}")
         for scope, d in ((r["config"], r),
                          (r["config"] + "[compact]", r.get("compact", {}))):
             for k in ("measured_ms_per_tick", "tick_bytes_accessed"):
@@ -258,13 +343,20 @@ def main(argv=None):
                     help="state layouts to measure: wide + compact with the "
                          "per-shape reduction (both, default), wide only "
                          "(off), compact only (on)")
+    ap.add_argument("--fused", choices=("off", "on"), default="off",
+                    help="also measure the fused ingest->schedule span "
+                         "(kernels/fused_tick.py) on each shape: per-phase "
+                         "executable boundary bytes vs the ONE fused-span "
+                         "executable's, plus the fused full-tick wall — "
+                         "exits nonzero unless the fused span streams "
+                         "strictly fewer bytes and places identical work")
     args = ap.parse_args(argv)
-    if args.quick and os.path.abspath(args.out) == os.path.abspath(
-            default_out):
-        # same discipline as bench.py's quick-vs-full results files: smoke
-        # shapes must never clobber the committed full-scale record
-        ap.error("--quick refuses to overwrite the full-scale record "
-                 f"({default_out}); pass an explicit --out")
+    # same discipline as bench.py's quick-vs-full results files: smoke
+    # shapes must never clobber the committed full-scale record (shared
+    # guard: tools/records.py — weak_scaling rides the same helper)
+    from tools.records import guard_full_record
+    guard_full_record(ap, quick=args.quick, out=args.out,
+                      default_out=default_out, flag="--out")
     n_ticks = args.ticks or (50 if args.quick else 200)
 
     all_shapes = list(shapes(quick=args.quick))
@@ -281,26 +373,29 @@ def main(argv=None):
           f"device={jax.devices()[0].device_kind} "
           f"n_devices={len(jax.devices())} jax={jax.__version__}",
           file=sys.stderr)
-    rows = [probe(*s, n_ticks=n_ticks, compact=args.compact)
+    rows = [probe(*s, n_ticks=n_ticks, compact=args.compact,
+                  fused=args.fused)
             for s in all_shapes]
     with open(args.out, "w") as f:
         json.dump(rows, f, indent=2)
     hdr = ("config", "ms/tick", "GFLOP/tick", "MB/tick", "FLOP/byte",
-           "achieved GB/s", "compact MB/tick", "bytes win")
+           "achieved GB/s", "compact MB/tick", "bytes win", "fused span win")
     print(f"{hdr[0]:<20}{hdr[1]:>9}{hdr[2]:>12}{hdr[3]:>10}{hdr[4]:>11}"
-          f"{hdr[5]:>15}{hdr[6]:>17}{hdr[7]:>11}")
+          f"{hdr[5]:>15}{hdr[6]:>17}{hdr[7]:>11}{hdr[8]:>16}")
     for r in rows:
         c = r.get("compact", {})
         win = (f"{c['bytes_reduction'] * 100:.1f}%"
                if "bytes_reduction" in c else "-")
         cmb = (f"{c['tick_bytes_accessed'] / 1e6:.1f}"
                if c.get("tick_bytes_accessed") else "-")
+        sb = r.get("fused", {}).get("span_bytes")
+        fwin = f"{sb['reduction'] * 100:.1f}%" if sb else "-"
         print(f"{r['config']:<20}{r.get('measured_ms_per_tick', '-'):>9}"
               f"{r.get('tick_flops', 0) / 1e9:>12.3f}"
               f"{r.get('tick_bytes_accessed', 0) / 1e6:>10.1f}"
               f"{r.get('arithmetic_intensity_flops_per_byte', '-'):>11}"
               f"{r.get('achieved_GB_per_s', '-'):>15}"
-              f"{cmb:>17}{win:>11}")
+              f"{cmb:>17}{win:>11}{fwin:>16}")
     print(f"# wrote {args.out}")
     problems = _check(rows, args.compact)
     for p in problems:

@@ -77,9 +77,13 @@ def _build(config: str, quick: bool):
 
 def phase_table(cfg, specs, arrivals, n_ticks: int, repeats: int = 3):
     """Per-phase ms/tick via cumulative phase-prefix ablation over the
-    real tick body. Returns [{phase, cum_ms_per_tick, ms_per_tick,
-    fraction}] in TICK_PHASES order, inactive phases (trader off, no
-    borrowing) included at ~0 by construction."""
+    real tick body, plus per-phase bytes from the SAME ablation: the XLA
+    cost-model bytes of a one-tick prefix-k executable minus prefix-(k-1)'s
+    is what running phase k adds to the tick's memory traffic at this
+    shape. Returns [{phase, cum_ms_per_tick, ms_per_tick, fraction,
+    prefix_bytes_delta}] in TICK_PHASES order, inactive phases (trader
+    off, no borrowing) included at ~0 by construction — the two columns
+    are the fusion-candidate evidence (``fusion_ranking`` below)."""
     import jax
 
     from multi_cluster_simulator_tpu.core.engine import (
@@ -91,6 +95,8 @@ def phase_table(cfg, specs, arrivals, n_ticks: int, repeats: int = 3):
     eng = Engine(cfg)
     state0 = init_state(cfg, specs)
     ta = pack_arrivals_by_tick(arrivals, n_ticks, cfg.tick_ms)
+    rows0 = jax.device_put(ta.rows[0])
+    cnt0 = jax.device_put(ta.counts[0])
 
     def timed(limit):
         fn = jax.jit(eng.run_prefix, static_argnums=(2, 3))
@@ -103,19 +109,51 @@ def phase_table(cfg, specs, arrivals, n_ticks: int, repeats: int = 3):
             walls.append(time.time() - t0)
         return min(walls) / n_ticks * 1e3  # ms/tick
 
+    def prefix_bytes(limit):
+        # one-tick prefix executable's cost-model bytes (compile only):
+        # the per-phase delta is the ablation's bytes column
+        def one_tick(s, rows, cnt):
+            return eng._tick(s, (rows, cnt), emit_io=False,
+                             tick_indexed=True, phase_limit=limit)[0]
+
+        try:
+            cost = jax.jit(one_tick).lower(state0, rows0,
+                                           cnt0).compile().cost_analysis()
+            if isinstance(cost, list):  # older jax returns [dict]
+                cost = cost[0] if cost else {}
+            return float(cost.get("bytes accessed", 0.0))
+        except Exception:  # pragma: no cover - cost model unavailable
+            return float("nan")
+
     cum = [timed(k) for k in range(len(TICK_PHASES) + 1)]  # k=0: carry only
+    cum_b = [prefix_bytes(k) for k in range(len(TICK_PHASES) + 1)]
     full = cum[-1]
     rows = []
     for i, name in enumerate(TICK_PHASES):
         per = cum[i + 1] - cum[i]
+        db = cum_b[i + 1] - cum_b[i]
         rows.append({"phase": name,
                      "cum_ms_per_tick": round(cum[i + 1], 4),
                      "ms_per_tick": round(per, 4),
-                     "fraction": round(per / full, 4) if full > 0 else 0.0})
+                     "fraction": round(per / full, 4) if full > 0 else 0.0,
+                     "prefix_bytes_delta": (int(db) if np.isfinite(db)
+                                            else None)})
     rows.append({"phase": "(carry/clock)", "cum_ms_per_tick": round(cum[0], 4),
                  "ms_per_tick": round(cum[0], 4),
-                 "fraction": round(cum[0] / full, 4) if full > 0 else 0.0})
+                 "fraction": round(cum[0] / full, 4) if full > 0 else 0.0,
+                 "prefix_bytes_delta": (int(cum_b[0])
+                                        if np.isfinite(cum_b[0]) else None)})
     return rows, full
+
+
+def fusion_ranking(rows):
+    """The machine-readable fusion-candidate ranking: tick phases ordered
+    by wall share, each with its ablation bytes delta — the recorded
+    provenance behind kernels/fused_tick.FUSED_SPAN's phase choice (the
+    top contiguous per-cluster-local span), so the choice is a measured
+    artifact, not folklore."""
+    cand = [r for r in rows if not r["phase"].startswith("(")]
+    return sorted(cand, key=lambda r: -r["fraction"])
 
 
 def main():
@@ -132,7 +170,15 @@ def main():
                          "(default ./profile_capture)")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the jax.profiler capture; only the table")
+    ap.add_argument("--fused", choices=("off", "on", "auto"), default="off",
+                    help="profile the engine with the fused ingest->"
+                         "schedule kernel engaged (kernels/fused_tick.py; "
+                         "the resolved provenance lands in the table JSON "
+                         "either way). Ablation prefixes that truncate "
+                         "INSIDE the span fall back to the unfused body")
     args = ap.parse_args()
+
+    import dataclasses
 
     import jax
 
@@ -147,8 +193,11 @@ def main():
         os.path.dirname(os.path.abspath(__file__)), "..", "profile_capture")
     os.makedirs(out_dir, exist_ok=True)
     cfg, specs, arrivals = _build(args.config, args.quick)
+    cfg = dataclasses.replace(cfg, fused=args.fused)
+    fused_prov = Engine(cfg).fused_provenance()
     print(f"# profile_capture: config={args.config} clusters={len(specs)} "
-          f"ticks={n_ticks} backend={jax.default_backend()}", file=sys.stderr)
+          f"ticks={n_ticks} backend={jax.default_backend()} "
+          f"fused={args.fused}", file=sys.stderr)
 
     # ---- per-phase cost table (phase-prefix ablation on the real tick) --
     rows, full = phase_table(cfg, specs, arrivals, n_ticks,
@@ -157,11 +206,17 @@ def main():
         print("profile_capture: per-phase table empty or degenerate",
               file=sys.stderr)
         return 1
+    ranking = fusion_ranking(rows)
     width = max(len(r["phase"]) for r in rows)
-    print(f"{'phase':{width}s}  ms/tick   cum      frac")
+    print(f"{'phase':{width}s}  ms/tick   cum      frac   ablation MB")
     for r in rows:
+        db = r.get("prefix_bytes_delta")
+        mb = f"{db / 1e6:8.2f}" if db is not None else "       -"
         print(f"{r['phase']:{width}s}  {r['ms_per_tick']:7.4f}  "
-              f"{r['cum_ms_per_tick']:7.4f}  {r['fraction']:6.1%}")
+              f"{r['cum_ms_per_tick']:7.4f}  {r['fraction']:6.1%}  {mb}")
+    print("# fusion candidates (wall share desc): "
+          + ", ".join(f"{r['phase']}={r['fraction']:.1%}"
+                      for r in ranking[:4]), file=sys.stderr)
 
     # ---- profiler trace around one full-tick run ------------------------
     artifacts = []
@@ -191,7 +246,9 @@ def main():
         json.dump({"config": args.config, "clusters": len(specs),
                    "ticks": n_ticks, "backend": jax.default_backend(),
                    "quick": args.quick, "full_ms_per_tick": round(full, 4),
-                   "phases": rows, "trace_artifacts": artifacts}, f, indent=2)
+                   "fused": fused_prov,
+                   "phases": rows, "fusion_ranking": ranking,
+                   "trace_artifacts": artifacts}, f, indent=2)
     print(f"# table: {table_path}", file=sys.stderr)
     return 0
 

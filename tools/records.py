@@ -1,0 +1,39 @@
+"""Shared discipline for the committed full-scale record files.
+
+Several CLIs (tools/cost_probe.py, tools/weak_scaling.py, bench.py's
+results split) write JSON records that graders and later rounds read.
+Their ``--quick`` smoke shapes must never silently overwrite a committed
+full-scale record — the guard lived as two drifting copies with one
+shared error string; this is the one home.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def guard_full_record(parser, *, quick: bool, out: str, default_out: str,
+                      flag: str = "--out", quick_key: str | None = None):
+    """Refuse to let a ``--quick`` run clobber the committed full-scale
+    record at ``default_out``; the error names ``flag`` — the option that
+    redirects the smoke output — so the fix is in the message.
+
+    ``quick_key``: when given, an existing record whose top-level JSON
+    object carries ``{quick_key: true}`` is itself a smoke artifact and
+    may be overwritten (tools/weak_scaling.py's convention); ``None``
+    refuses whenever the paths collide (tools/cost_probe.py's rows have
+    no such marker, so the committed path is always treated as full)."""
+    if not quick or os.path.abspath(out) != os.path.abspath(default_out):
+        return
+    if quick_key is not None:
+        if not os.path.exists(default_out):
+            return
+        try:
+            rec = json.load(open(default_out))
+            if isinstance(rec, dict) and rec.get(quick_key, False):
+                return  # the existing record is itself a smoke artifact
+        except (OSError, ValueError):
+            pass  # unreadable: treat as a full record worth protecting
+    parser.error("--quick refuses to overwrite the full-scale record "
+                 f"({default_out}); pass an explicit {flag}")

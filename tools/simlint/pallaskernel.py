@@ -1,0 +1,144 @@
+"""pallas-kernel pass (rule family 10): the hand-written kernel discipline.
+
+Everything under ``kernels/`` traces into Pallas kernel bodies or builds
+``pallas_call`` sites around them (kernels/fused_tick.py — the fused tick
+span). Three obligations, one family rule id ``pallas-kernel``
+(LINTING.md §10):
+
+- **Purity, unconditionally.** Kernel bodies are closures handed to
+  ``pallas_call`` — the call-graph's jit-entry reachability can't see
+  through that dispatch (the same blind spot as the policy zoo's
+  ``lax.switch`` tables), so the purity node checks (traced branches,
+  wall-clock/RNG, host coercions, bare ``np.`` on traced data, 64-bit
+  dtypes) apply to EVERY function in the module, reachable or not.
+
+- **Ref discipline.** Kernel refs (the ``*_ref``/``refs`` naming
+  convention) may only be touched through block indexing — ``ref[...]``
+  reads and ``ref[...] = v`` stores. An attribute access or method call on
+  a ref (``x_ref.mean()``, ``o_ref.at[...]``) bypasses the one-load /
+  one-store contract the fused kernel exists for (and half of those
+  forms silently materialize the whole buffer in interpret mode while
+  failing to lower on a real backend).
+
+- **The interpret flag is config, not a literal.** Every ``pallas_call``
+  site must thread ``interpret=`` from config
+  (``kernels.fused_tick.interpret_mode``): a missing kwarg or a hardcoded
+  ``interpret=False`` compiles the kernel unconditionally — on the CPU CI
+  host that either fails outright or, worse, silently diverges from the
+  oracle gating story (the whole bit-equality matrix runs interpret mode
+  there). A literal ``True`` is legal: an always-oracle site can never
+  un-gate itself.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint import purity
+from tools.simlint.callgraph import dotted_name
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+
+def module_is_pallas(mod: Module) -> bool:
+    """Single-file scoping heuristic (fixtures): does the module import
+    pallas or define ``*_ref``-parameter functions? Package runs scope by
+    directory (``kernels/``) instead."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            if ("pallas" in (node.module or "")
+                    or any("pallas" in (a.name or "") for a in node.names)):
+                return True
+        if isinstance(node, ast.Import) and any(
+                "pallas" in (a.name or "") for a in node.names):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            args = a.posonlyargs + a.args + a.kwonlyargs
+            args += [x for x in (a.vararg, a.kwarg) if x is not None]
+            if any(arg.arg.endswith("_ref") or arg.arg == "refs"
+                   for arg in args):
+                return True
+    return False
+
+
+def _is_ref_name(name: str) -> bool:
+    return name == "refs" or name.endswith("_ref") or name.endswith("_refs")
+
+
+def _ref_findings(fn) -> set:
+    """Attribute/method access on ref-named values inside one function."""
+    found = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                _is_ref_name(node.value.id):
+            found.add((node.lineno, "pallas-kernel",
+                       f"ref `{node.value.id}` touched through attribute "
+                       f"`.{node.attr}`: kernel refs may only be read/"
+                       "written through block indexing (`ref[...]` / "
+                       "`ref[...] = v`) — the one-load/one-store "
+                       "discipline the fused kernel exists for"))
+    return found
+
+
+def _pallas_call_findings(mod: Module) -> set:
+    """Every ``pallas_call`` site must thread ``interpret=`` from config —
+    missing kwarg or a literal ``False`` is the finding."""
+    found = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = (dotted_name(node.func) or "").split(".")[-1]
+        if d != "pallas_call":
+            continue
+        interp = [k for k in node.keywords if k.arg == "interpret"]
+        if not interp:
+            found.add((node.lineno, "pallas-kernel",
+                       "pallas_call without an `interpret=` kwarg: thread "
+                       "it from config (kernels.fused_tick.interpret_mode) "
+                       "so the CPU/CI oracle contract can never silently "
+                       "flip to a compiled kernel"))
+            continue
+        v = interp[0].value
+        if isinstance(v, ast.Constant) and v.value is False:
+            found.add((node.lineno, "pallas-kernel",
+                       "pallas_call(interpret=False) hardcodes the "
+                       "compiled path: thread the flag from config "
+                       "(kernels.fused_tick.interpret_mode) — on the CPU "
+                       "CI host this either fails to lower or un-gates "
+                       "the interpret-mode oracle"))
+    return found
+
+
+def check_module(mod: Module) -> list[Finding]:
+    raw: set[tuple] = set()
+    np_aliases = purity._np_alias_set(mod)
+    random_aliases = frozenset(
+        {a for a, m in mod.module_aliases.items() if m == "random"} | {
+            a for a, (src, orig) in mod.from_imports.items()
+            if src == "numpy" and orig == "random"})
+
+    # every top-level function and method; nested defs (the kernel bodies
+    # themselves) are walked as part of their parent — same traced program
+    def visit(node, inside_fn):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not inside_fn:
+                    tainter = purity._Tainter(child)
+                    # the engine handle carries static config/pset plumbing
+                    if "engine" in tainter.env:
+                        tainter.env["engine"] = False
+                    for n in ast.walk(child):
+                        purity._check_node(n, tainter, np_aliases,
+                                           random_aliases, raw)
+                raw.update(_ref_findings(child))
+                visit(child, True)
+            else:
+                visit(child, inside_fn)
+
+    visit(mod.tree, False)
+    raw.update(_pallas_call_findings(mod))
+    return [Finding(mod.path, line, "pallas-kernel",
+                    (msg if rule == "pallas-kernel" else f"[{rule}] {msg}"))
+            for (line, rule, msg) in sorted(raw)]

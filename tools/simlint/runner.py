@@ -7,7 +7,7 @@ from typing import Iterable, Optional
 
 from tools.simlint import (
     compactstore, determinism, envrng, findings as F, lockset, obstap,
-    policykernel, purity, servesync, shardexchange,
+    pallaskernel, policykernel, purity, servesync, shardexchange,
 )
 from tools.simlint.callgraph import CallGraph
 from tools.simlint.project import Module, in_scope, load_target
@@ -52,6 +52,12 @@ SHARD_EXCHANGE_RULES = ("shard-exchange",)
 # inside jit scope — the bit-invisibility contract, machine-checked
 OBS_TAP_DIRS = ("obs",)
 OBS_TAP_RULES = ("obs-tap",)
+# the hand-written kernels (ISSUE 15): pallas kernel bodies escape the
+# jit-entry reachability exactly like the policy zoo's dispatch tables, so
+# the purity node checks apply to every function under kernels/, plus the
+# ref block-indexing discipline and the interpret-from-config obligation
+PALLAS_KERNEL_DIRS = ("kernels",)
+PALLAS_KERNEL_RULES = ("pallas-kernel",)
 # serving-tier handler discipline (ISSUE 11): no blocking device syncs in
 # HTTP/gRPC handler scope — handlers stage and read snapshots only; the
 # per-request reference hosts are sanctioned inside the pass (they ARE the
@@ -60,8 +66,9 @@ SERVE_SYNC_DIRS = ("services",)
 SERVE_SYNC_RULES = ("serve-sync",)
 PRAGMA_RULES = ("pragma-no-reason", "pragma-stale")
 ALL_RULES = (PURITY_RULES + LOCKSET_RULES + DET_RULES + COMPACT_RULES
-             + POLICY_KERNEL_RULES + ENV_RNG_RULES + SHARD_EXCHANGE_RULES
-             + SERVE_SYNC_RULES + OBS_TAP_RULES + PRAGMA_RULES)
+             + POLICY_KERNEL_RULES + PALLAS_KERNEL_RULES + ENV_RNG_RULES
+             + SHARD_EXCHANGE_RULES + SERVE_SYNC_RULES + OBS_TAP_RULES
+             + PRAGMA_RULES)
 
 
 def run(target: str, rules: Optional[Iterable[str]] = None,
@@ -94,6 +101,10 @@ def run(target: str, rules: Optional[Iterable[str]] = None,
                 mod.relpath != "" or policykernel.module_takes_params(mod)):
             raw += policykernel.check_module(mod)
             checked.update(POLICY_KERNEL_RULES)
+        if in_scope(mod, PALLAS_KERNEL_DIRS) and (
+                mod.relpath != "" or pallaskernel.module_is_pallas(mod)):
+            raw += pallaskernel.check_module(mod)
+            checked.update(PALLAS_KERNEL_RULES)
         if in_scope(mod, ENV_RNG_DIRS) and (
                 mod.relpath != "" or envrng.module_is_env(mod)):
             raw += envrng.check_module(mod)

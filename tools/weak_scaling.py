@@ -395,15 +395,16 @@ def main(argv=None):
 
     devices = tuple(args.devices or ((1, 2) if args.quick else DEVICE_COUNTS))
     out = args.out or DEFAULT_OUT
-    if args.quick and args.out is None and os.path.exists(DEFAULT_OUT):
-        try:
-            full = not json.load(open(DEFAULT_OUT)).get("quick", False)
-        except (OSError, ValueError):
-            full = True
-        if full:
-            # smoke shapes must never clobber the committed full record
-            ap.error("--quick refuses to overwrite the full-scale record "
-                     f"({DEFAULT_OUT}); pass an explicit --out")
+    # smoke shapes must never clobber the committed full record (shared
+    # guard: tools/records.py). Guarded only when --out was DEFAULTED:
+    # an explicit `--out <record path>` is a deliberate refresh and
+    # passes, and an existing record that is itself a quick artifact
+    # (marked {"quick": true}) may be refreshed either way.
+    if args.out is None:
+        from tools.records import guard_full_record
+        guard_full_record(ap, quick=args.quick, out=out,
+                          default_out=DEFAULT_OUT, flag="--out",
+                          quick_key="quick")
 
     need = max(devices)
     import jax
