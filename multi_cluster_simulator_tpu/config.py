@@ -30,11 +30,15 @@ class MatchKind(str, enum.Enum):
 
     GREEDY reproduces the reference's cheapest-approving-seller heap
     (pkg/trader/trader.go:169-191,236-276) deterministically; SINKHORN is the
-    batched optimal-transport upgrade (BASELINE.json config 4).
+    batched optimal-transport upgrade (BASELINE.json config 4); CVX solves the
+    same assignment relaxation as an exact LP via fixed-iteration descending-
+    price dual ascent (market/cvx.py) — the per-tick pricing backend the
+    serving tier runs inside its coalesce window.
     """
 
     GREEDY = "greedy"
     SINKHORN = "sinkhorn"
+    CVX = "cvx"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,8 +70,25 @@ class TraderConfig:
     # by construction (fan-out + cheapest approver, trader.go:193-278) — a
     # live Sinkhorn would need a central matcher that protocol doesn't have.
     matching: MatchKind = MatchKind.GREEDY
+    # Solver hyperparameters. The iteration counts are STATIC scan lengths
+    # (the compiled loop trip count — fixed-iteration discipline, simlint
+    # family 11); every value below also lands as a traced PolicyParams
+    # ``mkt_*`` leaf (policies/base.py), so tournaments sweep the ACTIVE
+    # iteration count / temperatures within the static bound in one
+    # compiled program, and the values enter every params_digest.
     sinkhorn_iters: int = 16  # entropic-OT iterations (market/trader.py)
     sinkhorn_eps: float = 0.05  # entropic regularization temperature
+    cvx_iters: int = 128  # dual-ascent iterations (market/cvx.py)
+    cvx_step: float = 64.0  # primal sharpness 1/delta of the prox update
+    # opening price step; decays harmonically (rho/(1+i) at iteration i),
+    # so the total price sweep rho*H(n) ~ ln(n) diverges — an unmatched
+    # buyer's price always reaches zero — while the step vanishes and the
+    # equilibrium sharpens. The settle rule ties the three knobs: the
+    # final dual step rho/(1+iters) must sit under the primal band width
+    # 1/step, margin (1+iters)/(step*rho) >= 2 at the defaults, or the
+    # price/plan limit cycle never lands (market/cvx.py, schedule note)
+    cvx_rho: float = 1.0
+    cvx_smooth: float = 0.0  # price carry-over across rounds (0 = cold start)
     # "asbuilt" reproduces the reference's observable arithmetic (quirks
     # included); "sane" is the documented intended behavior (MARKET.md).
     small_node_sizing: str = "asbuilt"  # scheduler_client.go:201-289

@@ -52,7 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.config import MatchKind, SimConfig
 from multi_cluster_simulator_tpu.core import state as st
 from multi_cluster_simulator_tpu.core.state import Arrivals, SimState
 from multi_cluster_simulator_tpu.faults import apply as faults_apply
@@ -726,6 +726,26 @@ class Engine:
         return {"name": "|".join(self.pset.names),
                 "params_digest": params_digest(p)}
 
+    def market_provenance(self, params=None) -> dict:
+        """Market-backend provenance for detail dicts: which matching
+        kernel priced this run's trade rounds, at what solver depth, under
+        which traced hyperparameters (the params digest covers the mkt_*
+        leaves — policies/base.py), so A/B rows across market backends
+        stay joinable exactly like policy rows."""
+        from multi_cluster_simulator_tpu.policies.base import params_digest
+        tc = self.cfg.trader
+        out = {"enabled": bool(tc.enabled),
+               "matching": tc.matching.value if tc.enabled else None}
+        if tc.enabled:
+            out["params_digest"] = params_digest(
+                params if params is not None else self._default_params)
+            if tc.matching is MatchKind.SINKHORN:
+                out.update(iters=tc.sinkhorn_iters, eps=tc.sinkhorn_eps)
+            elif tc.matching is MatchKind.CVX:
+                out.update(iters=tc.cvx_iters, step=tc.cvx_step,
+                           rho=tc.cvx_rho, smooth=tc.cvx_smooth)
+        return out
+
     # -- single tick (pure; vmap/global composition) --
     def tick(self, state: SimState, arrivals: Arrivals) -> SimState:
         return self._tick(state, pack_arrivals(arrivals), emit_io=False)[0]
@@ -871,10 +891,11 @@ class Engine:
             with phase_scope("snapshot"):
                 state = _snapshot(state, t, cfg)
 
-        # 8. trader market round
+        # 8. trader market round (params carries the solver hyperparameter
+        # leaves — the pricing backends are sweepable policy data)
         if self._trade_round is not None and phase_on(8):
             with phase_scope("trade"):
-                state = self._trade_round(state, t)
+                state = self._trade_round(state, t, params=params)
 
         if node_narrow:
             # CHECKED, unlike the interior permutation narrows: the plan's

@@ -96,6 +96,14 @@ class TraderState:
     seller_locked_until: jax.Array  # [C] i32 — one-contract-at-a-time + 20s TTL
     next_contract_id: jax.Array  # [C] i32 — serial ids (trader/server.go:26,46)
     spent: jax.Array  # [C] f32 — cumulative price paid (budget accounting)
+    # Buyer dual price from the last cvx market round (market/cvx.py),
+    # refreshed every round cvx runs; ``mkt_smooth`` blends it into the
+    # next round's descending-price opening (0 = cold start from the score
+    # ceiling, the stored value then enters multiplied by zero). Part of
+    # SimState, so it checkpoints/reshards with every other column — the
+    # pricing plane is invisible to replay/resume (PARITY.md). Zero under
+    # the greedy/sinkhorn backends.
+    mkt_price: jax.Array  # [C] f32
 
 
 @struct.dataclass
@@ -334,6 +342,7 @@ def init_state(cfg: SimConfig, specs: Sequence[ClusterSpec],
             seller_locked_until=zi,
             next_contract_id=jnp.ones((C,), jnp.int32),
             spent=zf,
+            mkt_price=zf,
         ),
         trace=Trace(
             t=jnp.zeros((C, E), jnp.int32),

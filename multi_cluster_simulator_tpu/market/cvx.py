@@ -1,0 +1,141 @@
+"""The convex market kernel: assignment-LP pricing by descending-price
+dual ascent, cheap enough for every serving tick (ROADMAP item 1).
+
+The buyer<->seller contract round the trader market runs is, underneath
+the protocol, one linear program — the assignment relaxation
+
+    max  <score, x>
+    s.t. sum_b x[s, b] <= 1   (each seller carves one contract per round)
+         sum_s x[s, b] <= 1   (each buyer attaches one virtual node)
+         0 <= x <= 1,  x = 0 outside the feasibility mask
+
+over the same [seller, buyer] feasibility matrix ``_match_sinkhorn``
+builds (trader._pair_feasibility — ApproveTrade + sane-carve capacity).
+CvxCluster's observation (arxiv 2605.01614) is that this granular
+allocation LP decomposes per cluster: each cluster owns one primal ROW of
+``x`` and one seller dual, and the clusters couple only through the buyer
+prices — a [C_tot] vector that reduces across the mesh. That is exactly
+this codebase's idiom already: shard-local [s_loc, C_tot] rows, collective
+column sums through ``ex.allsum``, nothing [C_tot, C_tot] replicated.
+
+The solve is a FIXED-ITERATION primal-dual loop (``lax.scan`` over the
+static trip count ``cfg.trader.cvx_iters`` — no data-dependent
+``while_loop``, the PR-7 rejection-sampler lesson, machine-checked by
+simlint rule family 11):
+
+- primal: ``x = clip(step * (score - lam[b] - mu[s]), 0, 1) * feas`` —
+  the exact best response to the prox-regularized Lagrangian (sharpness
+  ``step`` = 1/delta), memoryless in x, so the plan is a pure function of
+  the prices;
+- dual: prices move by ``rho/(1+i) * clip(violation, -1, 1)`` and
+  project to >= 0 — the clip bounds one iteration's move, so the loop is
+  a simultaneous Dutch auction: prices OPEN AT THE SCORE CEILING (every
+  pair unprofitable) and fall toward market clearing, rising again only
+  where a buyer is oversubscribed. Opening at zero instead would
+  saturate every feasible ``x`` to 1 in the first iteration and the
+  rounding would collapse degenerately (the reason ascent-from-zero is
+  the wrong shape here). The harmonic decay is load-bearing — see the
+  schedule note below.
+
+Active depth, sharpness, price step and warm-start smoothing are traced
+``PolicyParams`` leaves (``mkt_iters``/``mkt_step``/``mkt_rho``/
+``mkt_smooth`` — trader.MktHyper), so a tournament sweeps pricing
+solvers like any other policy axis within the one compiled program.
+
+Rounding to integer contracts is the shared deterministic rule in
+``trader._round_plan_to_matching`` (documented in MARKET.md §"The
+rounding rule"); determinism across compact storage, time compression,
+chunking, faults, the 8-device mesh and checkpoint cuts rides the same
+pins ``_match_sinkhorn`` carries (tests/test_market_cvx.py). The scipy
+``linprog`` oracle gate (small shape, exact integer contracts) lives in
+the same test file; tools/market_ab.py measures the three-way quality
+A/B this kernel must win.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from multi_cluster_simulator_tpu.market import trader as T
+
+# Tie-break scale for the deterministic per-pair jitter added to the
+# normalized value (trader._pair_jitter): far below any real value
+# difference, large enough to keep the rounding's argmax off exact-tie
+# boundaries (the same role eps/2 plays for the sinkhorn kernel).
+JITTER_SCALE = 0.0001
+# The opening price: one jitter band above the score ceiling (values
+# normalize to <= 1 plus the jitter), so every pair opens unprofitable.
+PRICE_CEIL = 1.0 + 2.0 * JITTER_SCALE
+# The dual step schedule is HARMONIC: iteration i moves a price by at
+# most rho / (1 + i). Subgradient ascent needs a divergent-sum,
+# vanishing-step schedule to actually reach the optimal prices —
+# geometric cooling freezes the prices wherever the sweep ran out
+# (remaining movement after iteration i is bounded by a constant times
+# DECAY^i, so a late augmenting-path correction that needs one price to
+# travel can never happen), and a fixed step orbits a limit cycle (the
+# price bounces across the primal band 1/step, the plan slams 0 <-> 1).
+# Harmonic gives both: total sweep rho * H(n) ~ rho * ln(n) diverges
+# (an unmatched buyer's price always reaches zero), while the step
+# vanishes so the equilibrium sharpens. Against the scipy LP oracle
+# this is the difference between ~40% and 0% mismatched matchings
+# (tests/test_market_cvx.py).
+
+
+def solve_prices(feas, score, lam0, hp, n_iters, ex):
+    """The fixed-iteration descending-price solve. ``feas``/``score`` are
+    the shard-local [s_loc, C_tot] rows, ``lam0`` the [C_tot] opening
+    buyer prices (replicated — derived from gathered state), ``hp`` a
+    trader.MktHyper, ``n_iters`` the STATIC scan length (``hp.iters``
+    masks the active depth inside it). Returns (x [s_loc, C_tot] plan,
+    lam [C_tot] closing buyer prices). Every cross-shard quantity reduces
+    through ``ex.allsum`` (deterministic fixed-order combining), so the
+    prices — and therefore the plan — are identical on every shard."""
+    C_loc, C_tot = feas.shape
+    fmask = feas.astype(jnp.float32)
+    x0 = jnp.zeros((C_loc, C_tot), jnp.float32)
+    mu0 = jnp.zeros((C_loc,), jnp.float32)
+
+    def step(carry, i):
+        x, lam, mu = carry
+        act = i < hp.iters  # masked active depth (traced, sweepable)
+        # primal best response to the current prices (prox sharpness 1/step)
+        g = score - lam[None, :] - mu[:, None]
+        x2 = jnp.clip(hp.step * g, 0.0, 1.0) * fmask
+        # clipped, harmonically decayed dual updates: iteration i moves a
+        # price at most rho / (1 + i) in either direction
+        rho_i = hp.rho / (1.0 + i.astype(jnp.float32))
+        col = ex.allsum(jnp.sum(x2, axis=0)) - 1.0  # buyer oversubscription
+        row = jnp.sum(x2, axis=1) - 1.0  # seller oversubscription
+        lam2 = jnp.maximum(lam + rho_i * jnp.clip(col, -1.0, 1.0), 0.0)
+        mu2 = jnp.maximum(mu + rho_i * jnp.clip(row, -1.0, 1.0), 0.0)
+        return (jnp.where(act, x2, x), jnp.where(act, lam2, lam),
+                jnp.where(act, mu2, mu)), None
+
+    (x, lam, _), _ = jax.lax.scan(step, (x0, lam0, mu0),
+                                  jnp.arange(n_iters, dtype=jnp.int32))
+    return x, lam
+
+
+def match_cvx(state, tr, t, mcfg, ex, gidx, g_buyer, g_con, hp):
+    """MatchKind.CVX: the same signature contract as the other matchers
+    plus the refreshed [C_loc] buyer-price column. Feasibility, value,
+    jitter and rounding are the sinkhorn kernel's own helpers — the two
+    backends price the identical market and differ only in the solver
+    between feasibility and rounding."""
+    C_tot = g_buyer.shape[0]
+    feas = T._pair_feasibility(state, tr, t, mcfg, gidx, g_buyer, g_con)
+    v = T._pair_value(g_con)
+    score = v[None, :] + T._pair_jitter(gidx, C_tot) * jnp.float32(JITTER_SCALE)
+
+    # warm start: blend last round's closing prices into the opening (a
+    # smooth of 0 — the default — multiplies the stored price by zero:
+    # cold start from the ceiling, bit-independent of the carried column)
+    g_price = ex.gather(tr.mkt_price)  # [C_tot]
+    lam0 = hp.smooth * g_price + (1.0 - hp.smooth) * jnp.float32(PRICE_CEIL)
+    x, lam = solve_prices(feas, score, lam0, hp, mcfg.cvx_iters, ex)
+
+    winner, csel, amounts, win_sell = T._round_plan_to_matching(
+        state, x, feas, gidx, g_con, ex)
+    new_price = lam[gidx]  # this shard's clusters' closing buyer prices
+    return winner, csel, amounts, win_sell, tr.seller_locked_until, new_price

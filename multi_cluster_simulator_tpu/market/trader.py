@@ -26,6 +26,8 @@ Phase structure of one round (MARKET.md):
 
 from __future__ import annotations
 
+import typing
+
 import jax
 import jax.numpy as jnp
 
@@ -48,10 +50,41 @@ def _tree_take(tree, idx):
     return jax.tree.map(lambda x: x[idx], tree)
 
 
-def trade_round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
+class MktHyper(typing.NamedTuple):
+    """The traced solver hyperparameters one market round runs with —
+    PolicyParams ``mkt_*`` leaves when a params pytree is threaded (every
+    engine path), the TraderConfig constants otherwise. Iteration counts
+    are ACTIVE counts masked inside the static scan lengths the config
+    compiles (cfg.trader.sinkhorn_iters / cvx_iters): the trip count is
+    shape, the effective depth is sweepable data."""
+
+    sink_iters: jax.Array  # [] i32
+    sink_eps: jax.Array  # [] f32
+    iters: jax.Array  # [] i32 — cvx active iterations
+    step: jax.Array  # [] f32 — cvx primal sharpness (1/delta)
+    rho: jax.Array  # [] f32 — cvx price step
+    smooth: jax.Array  # [] f32 — cvx price carry-over
+
+
+def market_hyper(mcfg, params=None) -> MktHyper:
+    if params is None:
+        return MktHyper(sink_iters=jnp.int32(mcfg.sinkhorn_iters),
+                        sink_eps=jnp.float32(mcfg.sinkhorn_eps),
+                        iters=jnp.int32(mcfg.cvx_iters),
+                        step=jnp.float32(mcfg.cvx_step),
+                        rho=jnp.float32(mcfg.cvx_rho),
+                        smooth=jnp.float32(mcfg.cvx_smooth))
+    return MktHyper(sink_iters=params.mkt_sink_iters,
+                    sink_eps=params.mkt_sink_eps,
+                    iters=params.mkt_iters, step=params.mkt_step,
+                    rho=params.mkt_rho, smooth=params.mkt_smooth)
+
+
+def trade_round(state: SimState, t, cfg: SimConfig, ex, params=None) -> SimState:
     mcfg = cfg.trader
     do = (t % mcfg.monitor_period_ms) == 0
-    return jax.lax.cond(do, lambda s: _round(s, t, cfg, ex), lambda s: s, state)
+    return jax.lax.cond(do, lambda s: _round(s, t, cfg, ex, params),
+                        lambda s: s, state)
 
 
 def next_cadence_t(t, mcfg) -> jax.Array:
@@ -128,7 +161,106 @@ def _match_greedy(state: SimState, tr, t, mcfg, ex, gidx, g_buyer, g_con):
     return winner, csel, amounts, win_sell, new_lock
 
 
-def _match_sinkhorn(state: SimState, tr, t, mcfg, ex, gidx, g_buyer, g_con):
+def _pair_feasibility(state: SimState, tr, t, mcfg, gidx, g_buyer, g_con):
+    """The [s_loc, b] feasibility matrix the batched matchers (sinkhorn,
+    cvx) share: ApproveTrade against the snapshot (thresholds, available
+    capacity, price >= incentive, seller not locked) AND sane-carve
+    capacity (total free over active nodes covers the request, per
+    resource including gpu) AND the pair is (requesting buyer, not self).
+    One definition so the two solvers price the identical market."""
+    bidx = jnp.arange(g_buyer.shape[0], dtype=jnp.int32)
+    locked = tr.seller_locked_until > t
+
+    thresh_ok = jnp.logical_and(tr.snap_core_util < mcfg.approve_core_threshold,
+                                tr.snap_mem_util < mcfg.approve_mem_threshold)
+    tot_c = tr.snap_total_cores.astype(jnp.float32)
+    tot_m = tr.snap_total_mem.astype(jnp.float32)
+    avail_c = tot_c - tot_c * tr.snap_core_util  # [s_loc]
+    avail_m = tot_m - tot_m * tr.snap_mem_util
+    t_sec = g_con.time_ms.astype(jnp.float32) / 1000.0  # [b]
+    incentive = (jnp.float32(mcfg.min_core_incentive) * g_con.cores.astype(jnp.float32)
+                 + jnp.float32(mcfg.min_mem_incentive) * g_con.mem.astype(jnp.float32)) * t_sec
+    approve = jnp.logical_and(
+        jnp.logical_and(thresh_ok, jnp.logical_not(locked))[:, None],
+        jnp.logical_and(
+            jnp.logical_and(avail_c[:, None] >= g_con.cores[None, :].astype(jnp.float32),
+                            avail_m[:, None] >= g_con.mem[None, :].astype(jnp.float32)),
+            (g_con.price >= incentive)[None, :]))
+    # sane-carve feasibility: total free (active nodes) covers the request,
+    # per resource including gpu
+    tot_free = jnp.sum(jnp.where(state.node_active[..., None],
+                                 jnp.maximum(state.node_free, 0), 0),
+                       axis=1)  # [s_loc, RES]
+    req = jnp.stack([g_con.cores, g_con.mem, g_con.gpu], axis=-1)  # [b, RES]
+    cap_ok = jnp.all(tot_free[:, None, :] >= req[None, :, :], axis=-1)
+    return jnp.logical_and(jnp.logical_and(approve, cap_ok),
+                           jnp.logical_and(g_buyer[None, :],
+                                           gidx[:, None] != bidx[None, :]))
+
+
+def _pair_value(g_con):
+    """Buyer value: normalized resource volume (what a matched contract is
+    worth); sellers are symmetric, the solver iterations spread buyers
+    across them."""
+    v = (g_con.cores.astype(jnp.float32)
+         + g_con.mem.astype(jnp.float32) / 1024.0
+         + 4.0 * g_con.gpu.astype(jnp.float32))
+    return v / jnp.maximum(jnp.max(v), 1.0)
+
+
+def _pair_jitter(gidx, C_tot):
+    """Deterministic per-pair jitter in [0, 1) breaking exact ties
+    (identical contracts from several buyers would otherwise produce
+    identical plan columns and the argmax rounding would collapse every
+    buyer onto one seller); callers scale it well under their value scale
+    so it only decides degenerate cases. Rows index GLOBAL seller ids so
+    every shard derives the same values."""
+    sidx = gidx.astype(jnp.float32)
+    bfdx = jnp.arange(C_tot, dtype=jnp.float32)
+    return jnp.abs(jnp.modf(jnp.sin(sidx[:, None] * 12.9898
+                                    + bfdx[None, :] * 78.233) * 43758.5453)[0])
+
+
+def _round_plan_to_matching(state: SimState, plan, feas, gidx, g_con, ex):
+    """The deterministic rounding rule both fractional matchers share
+    (MARKET.md §"The rounding rule"): each buyer claims its argmax-plan
+    feasible seller — an ``allmax`` of column maxima, ties resolved to the
+    LOWEST global seller index via ``allmin`` — then each claimed seller
+    keeps its highest-plan claimant, the sane carve re-checks, and the
+    committed winner index min-reduces across shards. Returns
+    (winner [C_tot] global seller or INF, csel, amounts, win_sell)."""
+    INF = jnp.int32(2**31 - 1)
+    any_s = ex.allmax(jnp.any(feas, axis=0).astype(jnp.int32)) > 0  # [b]
+    colmax = ex.allmax(jnp.max(jnp.where(feas, plan, -1.0), axis=0))  # [b]
+    at_max = jnp.logical_and(feas, plan >= colmax[None, :])
+    cand = ex.allmin(jnp.min(jnp.where(at_max, gidx[:, None], INF), axis=0))
+    cand = jnp.where(any_s, cand, INF)
+    claim = jnp.logical_and(cand[None, :] == gidx[:, None], feas)  # [s_loc, b]
+    best_b = jnp.argmax(jnp.where(claim, plan, -1.0), axis=1).astype(jnp.int32)
+    seller_matched = jnp.any(claim, axis=1)
+
+    # ---- local seller views + actual carve (sane mode is exactly the
+    # cap_ok feasibility test, so carve_ok holds for every matched seller) ----
+    sel_b = best_b  # my sellers' chosen buyers (rows are already local)
+    win_sell = seller_matched
+    csel = _tree_take(g_con, sel_b)
+    amounts, carve_ok = jax.vmap(
+        lambda free, act, ccon: carve_ops.carve_plan(
+            free, act, ccon.cores, ccon.mem, ccon.gpu, mode="sane")
+    )(state.node_free, state.node_active, csel)
+    win_sell = jnp.logical_and(win_sell, carve_ok)
+
+    # winner[b] = the global seller that committed to b (INF = unmatched),
+    # assembled from local commitments and min-reduced across shards
+    C_tot = feas.shape[1]
+    local_winner = jnp.full((C_tot,), INF, jnp.int32).at[
+        jnp.where(win_sell, sel_b, C_tot)].set(
+        jnp.where(win_sell, gidx, INF), mode="drop")
+    winner = ex.allmin(local_winner)
+    return winner, csel, amounts, win_sell
+
+
+def _match_sinkhorn(state: SimState, tr, t, mcfg, ex, gidx, g_buyer, g_con, hp):
     """Batched optimal-transport matching (BASELINE config 4) — the upgrade
     over the greedy heap: instead of each seller seeing only its first
     requesting buyer, the full (seller × buyer) feasibility matrix enters an
@@ -164,109 +296,42 @@ def _match_sinkhorn(state: SimState, tr, t, mcfg, ex, gidx, g_buyer, g_con):
     """
     C_loc = gidx.shape[0]
     C_tot = g_buyer.shape[0]
-    INF = jnp.int32(2**31 - 1)
-    bidx = jnp.arange(C_tot, dtype=jnp.int32)
 
-    locked = tr.seller_locked_until > t
-
-    # ---- per-pair feasibility [s_loc, b] ----
-    thresh_ok = jnp.logical_and(tr.snap_core_util < mcfg.approve_core_threshold,
-                                tr.snap_mem_util < mcfg.approve_mem_threshold)
-    tot_c = tr.snap_total_cores.astype(jnp.float32)
-    tot_m = tr.snap_total_mem.astype(jnp.float32)
-    avail_c = tot_c - tot_c * tr.snap_core_util  # [s_loc]
-    avail_m = tot_m - tot_m * tr.snap_mem_util
-    t_sec = g_con.time_ms.astype(jnp.float32) / 1000.0  # [b]
-    incentive = (jnp.float32(mcfg.min_core_incentive) * g_con.cores.astype(jnp.float32)
-                 + jnp.float32(mcfg.min_mem_incentive) * g_con.mem.astype(jnp.float32)) * t_sec
-    approve = jnp.logical_and(
-        jnp.logical_and(thresh_ok, jnp.logical_not(locked))[:, None],
-        jnp.logical_and(
-            jnp.logical_and(avail_c[:, None] >= g_con.cores[None, :].astype(jnp.float32),
-                            avail_m[:, None] >= g_con.mem[None, :].astype(jnp.float32)),
-            (g_con.price >= incentive)[None, :]))
-    # sane-carve feasibility: total free (active nodes) covers the request,
-    # per resource including gpu
-    tot_free = jnp.sum(jnp.where(state.node_active[..., None],
-                                 jnp.maximum(state.node_free, 0), 0),
-                       axis=1)  # [s_loc, RES]
-    req = jnp.stack([g_con.cores, g_con.mem, g_con.gpu], axis=-1)  # [b, RES]
-    cap_ok = jnp.all(tot_free[:, None, :] >= req[None, :, :], axis=-1)
-    feas = jnp.logical_and(jnp.logical_and(approve, cap_ok),
-                           jnp.logical_and(g_buyer[None, :],
-                                           gidx[:, None] != bidx[None, :]))
+    feas = _pair_feasibility(state, tr, t, mcfg, gidx, g_buyer, g_con)
 
     # ---- shard-local kernel rows [s_loc, C_tot]; Sinkhorn iterations ----
-    # buyer value: normalized resource volume (what a matched contract is
-    # worth); sellers are symmetric, the iterations spread buyers across them
-    v = (g_con.cores.astype(jnp.float32)
-         + g_con.mem.astype(jnp.float32) / 1024.0
-         + 4.0 * g_con.gpu.astype(jnp.float32))
-    v = v / jnp.maximum(jnp.max(v), 1.0)
-    # deterministic per-pair jitter breaks exact ties (identical contracts
-    # from several buyers would otherwise produce identical plan columns and
-    # the argmax rounding would collapse every buyer onto one seller); kept
-    # well under the value scale so it only decides degenerate cases.
-    # Rows index GLOBAL seller ids so every shard derives the same values.
-    sidx = gidx.astype(jnp.float32)
-    bfdx = jnp.arange(C_tot, dtype=jnp.float32)
-    jitter = jnp.modf(jnp.sin(sidx[:, None] * 12.9898
-                              + bfdx[None, :] * 78.233) * 43758.5453)[0]
-    eps = jnp.float32(mcfg.sinkhorn_eps)
-    score = v[None, :] + jnp.abs(jitter) * (0.5 * eps)
+    # the jitter is kept well under the value scale (~eps/2) so it only
+    # decides degenerate cases
+    v = _pair_value(g_con)
+    eps = hp.sink_eps
+    score = v[None, :] + _pair_jitter(gidx, C_tot) * (0.5 * eps)
     K = jnp.where(feas, jnp.exp(score / eps), 0.0)  # [s_loc, C_tot]
     tiny = jnp.float32(1e-30)
 
-    def sink_step(uv, _):
+    def sink_step(uv, i):
         u, vc = uv  # u: [s_loc] (my sellers), vc: [C_tot] (all buyers)
-        u = 1.0 / jnp.maximum(K @ vc, tiny)
-        vc = 1.0 / jnp.maximum(ex.allsum(K.T @ u), tiny)
-        return (u, vc), None
+        act = i < hp.sink_iters  # masked active depth (traced, sweepable)
+        u2 = 1.0 / jnp.maximum(K @ vc, tiny)
+        vc2 = 1.0 / jnp.maximum(ex.allsum(K.T @ u2), tiny)
+        return (jnp.where(act, u2, u), jnp.where(act, vc2, vc)), None
 
     (u, vc), _ = jax.lax.scan(
         sink_step, (jnp.ones((C_loc,), jnp.float32), jnp.ones((C_tot,), jnp.float32)),
-        None, length=mcfg.sinkhorn_iters)
+        jnp.arange(mcfg.sinkhorn_iters, dtype=jnp.int32))
     plan = u[:, None] * K * vc[None, :]  # [s_loc, C_tot]
 
-    # ---- round to a one-to-one matching: each buyer claims its argmax
-    # seller (lowest global index on ties — allmax of column maxima, then
-    # allmin over the sellers attaining it); each claimed seller keeps its
-    # highest-plan claimant ----
-    any_s = ex.allmax(jnp.any(feas, axis=0).astype(jnp.int32)) > 0  # [b]
-    colmax = ex.allmax(jnp.max(jnp.where(feas, plan, -1.0), axis=0))  # [b]
-    at_max = jnp.logical_and(feas, plan >= colmax[None, :])
-    cand = ex.allmin(jnp.min(jnp.where(at_max, gidx[:, None], INF), axis=0))
-    cand = jnp.where(any_s, cand, INF)
-    claim = jnp.logical_and(cand[None, :] == gidx[:, None], feas)  # [s_loc, b]
-    best_b = jnp.argmax(jnp.where(claim, plan, -1.0), axis=1).astype(jnp.int32)
-    seller_matched = jnp.any(claim, axis=1)
-
-    # ---- local seller views + actual carve (sane mode is exactly the
-    # cap_ok feasibility test, so carve_ok holds for every matched seller) ----
-    sel_b = best_b  # my sellers' chosen buyers (rows are already local)
-    win_sell = seller_matched
-    csel = _tree_take(g_con, sel_b)
-    amounts, carve_ok = jax.vmap(
-        lambda free, act, ccon: carve_ops.carve_plan(
-            free, act, ccon.cores, ccon.mem, ccon.gpu, mode="sane")
-    )(state.node_free, state.node_active, csel)
-    win_sell = jnp.logical_and(win_sell, carve_ok)
-
-    # winner[b] = the global seller that committed to b (INF = unmatched),
-    # assembled from local commitments and min-reduced across shards
-    local_winner = jnp.full((C_tot,), INF, jnp.int32).at[
-        jnp.where(win_sell, sel_b, C_tot)].set(
-        jnp.where(win_sell, gidx, INF), mode="drop")
-    winner = ex.allmin(local_winner)
+    winner, csel, amounts, win_sell = _round_plan_to_matching(
+        state, plan, feas, gidx, g_con, ex)
     return winner, csel, amounts, win_sell, tr.seller_locked_until
 
 
-def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
+def _round(state: SimState, t, cfg: SimConfig, ex, params=None) -> SimState:
     """One market round over the (possibly sharded) cluster axis. Local
     arrays are [C_loc]; gathered arrays are [C_tot]. Single-device,
     C_loc == C_tot and the exchange ops are identities."""
     mcfg = cfg.trader
     tr = state.trader
+    hp = market_hyper(mcfg, params)
     C_loc = state.arr_ptr.shape[0]
     INF = jnp.int32(2**31 - 1)
     gidx = ex.global_index(C_loc)
@@ -300,9 +365,16 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
     g_buyer = ex.gather(buyer)  # [C_tot]
     g_con = jax.tree.map(ex.gather, con)
 
-    if mcfg.matching == MatchKind.SINKHORN:
+    new_price = tr.mkt_price
+    if mcfg.matching == MatchKind.CVX:
+        # function-level import: cvx.py imports this module's shared
+        # helpers, so the dispatch edge must not close the cycle at import
+        from multi_cluster_simulator_tpu.market import cvx as cvx_mod
+        winner, csel, amounts, win_sell, new_lock, new_price = \
+            cvx_mod.match_cvx(state, tr, t, mcfg, ex, gidx, g_buyer, g_con, hp)
+    elif mcfg.matching == MatchKind.SINKHORN:
         winner, csel, amounts, win_sell, new_lock = _match_sinkhorn(
-            state, tr, t, mcfg, ex, gidx, g_buyer, g_con)
+            state, tr, t, mcfg, ex, gidx, g_buyer, g_con, hp)
     else:
         winner, csel, amounts, win_sell, new_lock = _match_greedy(
             state, tr, t, mcfg, ex, gidx, g_buyer, g_con)
@@ -382,6 +454,6 @@ def _round(state: SimState, t, cfg: SimConfig, ex) -> SimState:
         drops=state.drops.replace(vslot=state.drops.vslot + vslot_miss,
                                   carve=state.drops.carve + carve_miss),
         trader=tr.replace(seller_locked_until=new_lock, cooldown_until=cooldown,
-                          spent=spent,
+                          spent=spent, mkt_price=new_price,
                           next_contract_id=tr.next_contract_id
                           + buyer.astype(jnp.int32)))

@@ -65,6 +65,18 @@ class PolicyParams:
     #   types per job class, fed through the same scored sweep as gavel.
     #   The zero default makes every score equal, which degenerates to
     #   first-fit (ops/placement.best_scored_fit ties -> lowest index).
+    # -- market solver hyperparameters (market/trader.py, market/cvx.py):
+    # the trader's pricing backends read these so a tournament sweeps the
+    # solvers alongside the scheduling policies in the same compiled
+    # program. Iteration counts are the ACTIVE counts, masked within the
+    # static scan length cfg.trader.*_iters compiles (values above the
+    # static bound clamp to it — the trip count is shape, not data).
+    mkt_sink_iters: jax.Array  # [] i32 — active Sinkhorn iterations
+    mkt_sink_eps: jax.Array  # [] f32 — entropic temperature
+    mkt_iters: jax.Array  # [] i32 — active cvx dual-ascent iterations
+    mkt_step: jax.Array  # [] f32 — cvx primal sharpness (1/delta)
+    mkt_rho: jax.Array  # [] f32 — cvx price step per iteration
+    mkt_smooth: jax.Array  # [] f32 — cvx price carry-over across rounds
 
 
 # Default Gavel throughput matrix [job class, device type]: gpu-class work
@@ -142,6 +154,14 @@ register(PolicySpec("rl", kind="rl", to_delay=True))
 variant("delay-eager", "delay", max_wait_ms=2_000)
 variant("delay-patient", "delay", max_wait_ms=30_000)
 variant("ffd-memfirst", "ffd", ffd_mem_first=1)
+# The convex market kernel's sweep axis (market/cvx.py): same scheduling
+# kernel, different pricing-solver leaves — under a trader-enabled cvx
+# config these are distinct market policies a tournament runs in one
+# compiled program (the static scan length stays cfg.trader.cvx_iters;
+# the leaves move the active count / steps within it).
+variant("delay-cvx-fast", "delay", mkt_iters=64)
+variant("delay-cvx-tight", "delay", mkt_rho=1.5)
+variant("delay-cvx-smooth", "delay", mkt_smooth=0.5)
 
 
 def default_params(cfg: SimConfig, spec: PolicySpec, idx: int = 0) -> PolicyParams:
@@ -156,6 +176,12 @@ def default_params(cfg: SimConfig, spec: PolicySpec, idx: int = 0) -> PolicyPara
         "tess_w": np.asarray(_DEFAULT_TESS_W, np.float32),
         "rl_scores": np.zeros(
             (F.N_JOB_CLASSES, F.N_DEVICE_TYPES), np.float32),
+        "mkt_sink_iters": np.int32(cfg.trader.sinkhorn_iters),
+        "mkt_sink_eps": np.float32(cfg.trader.sinkhorn_eps),
+        "mkt_iters": np.int32(cfg.trader.cvx_iters),
+        "mkt_step": np.float32(cfg.trader.cvx_step),
+        "mkt_rho": np.float32(cfg.trader.cvx_rho),
+        "mkt_smooth": np.float32(cfg.trader.cvx_smooth),
     }
     for name, val in spec.overrides:
         if name not in vals:
@@ -166,7 +192,13 @@ def default_params(cfg: SimConfig, spec: PolicySpec, idx: int = 0) -> PolicyPara
                         ffd_mem_first=jnp.asarray(vals["ffd_mem_first"]),
                         gavel_tput=jnp.asarray(vals["gavel_tput"]),
                         tess_w=jnp.asarray(vals["tess_w"]),
-                        rl_scores=jnp.asarray(vals["rl_scores"]))
+                        rl_scores=jnp.asarray(vals["rl_scores"]),
+                        mkt_sink_iters=jnp.asarray(vals["mkt_sink_iters"]),
+                        mkt_sink_eps=jnp.asarray(vals["mkt_sink_eps"]),
+                        mkt_iters=jnp.asarray(vals["mkt_iters"]),
+                        mkt_step=jnp.asarray(vals["mkt_step"]),
+                        mkt_rho=jnp.asarray(vals["mkt_rho"]),
+                        mkt_smooth=jnp.asarray(vals["mkt_smooth"]))
 
 
 def params_digest(params: PolicyParams) -> str:

@@ -58,7 +58,8 @@ from typing import Optional
 import jax
 import numpy as np
 
-from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+from multi_cluster_simulator_tpu.config import (MatchKind, PolicyKind,
+                                                SimConfig)
 from multi_cluster_simulator_tpu.core import state as st
 from multi_cluster_simulator_tpu.core.engine import Engine, round_up_pow2
 from multi_cluster_simulator_tpu.core.state import init_state
@@ -150,7 +151,9 @@ class ServingScheduler(Service):
                  wal_path: Optional[str] = None,
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: int = 8, recover: bool = True,
-                 wal_rotate_bytes: int = 64 << 20, **kw):
+                 wal_rotate_bytes: int = 64 << 20,
+                 pricing_budget_ms: Optional[float] = None,
+                 pricing_reprobe: int = 64, **kw):
         """Crash recovery (services/wal.py, ARCHITECTURE.md §fault plane):
         ``wal_path`` arms the staged-arrival write-ahead log — every
         accepted submit is fsync'd to it BEFORE the 200-ack, so an acked
@@ -200,6 +203,39 @@ class ServingScheduler(Service):
         else:
             self.snapshot_max_age_ms = None
         self.engine = Engine(cfg)
+        # per-tick pricing (the convex market kernel, market/cvx.py): when
+        # the hosted config arms the trader with MatchKind.CVX, every
+        # trade round inside a coalesced dispatch solves the contract LP —
+        # all of it on the drive thread's run_io dispatch, never a handler
+        # (the serve-sync contract is untouched: pricing is just more tick
+        # phases inside the one compiled program). ``pricing_budget_ms``
+        # arms a HARD per-round wall budget: the dispatch is then timed
+        # against budget * rounds-in-window, and a blown budget flips a
+        # sticky fallback to a pre-warmed greedy-matching executable
+        # (same state shapes — mkt_price is always in SimState — so the
+        # donated state moves between the two executables freely). Every
+        # trip is counted in ``pricing_fallbacks`` and surfaced in
+        # provenance()/metrics; the flag re-probes the solver every
+        # ``pricing_reprobe`` dispatches so a transient stall does not
+        # demote pricing forever. Arming a budget makes each dispatch
+        # synchronous (a wall measurement needs the device to finish) —
+        # the documented cost of the budget, not of the solver.
+        self._pricing_armed = bool(cfg.trader.enabled
+                                   and cfg.trader.matching is MatchKind.CVX)
+        self.pricing_budget_ms = (float(pricing_budget_ms)
+                                  if pricing_budget_ms is not None else None)
+        self.pricing_reprobe = max(int(pricing_reprobe), 1)
+        self.pricing_fallbacks = 0
+        self._pricing_fallback = False
+        self._pricing_since_probe = 0
+        self._run_io_fallback = None
+        if self._pricing_armed and self.pricing_budget_ms is not None:
+            import dataclasses as _dc
+            fb_cfg = _dc.replace(cfg, trader=_dc.replace(
+                cfg.trader, matching=MatchKind.GREEDY))
+            self._fallback_engine = Engine(fb_cfg)
+            self._run_io_fallback = self._fallback_engine.run_io_jit(
+                donate=True)
         # the device state has ONE owner — the drive thread (or the
         # deterministic driver): handlers never read or write it, so no
         # state lock exists by construction. Leaves are cloned once so
@@ -978,12 +1014,19 @@ class ServingScheduler(Service):
                 if lst:
                     counts[ti, c] = len(lst)
                     rows[ti, c, :len(lst)] = np.asarray(lst, np.int32)
+        run_io, timed = self._pricing_exec()
+        t_in = time.perf_counter() if timed else 0.0
         with annotate_dispatch("serving", ticks=T, jobs=n_jobs):
             if self.obs:
-                self._state, io, self._mbuf = self._run_io(
+                self._state, io, self._mbuf = run_io(
                     self._state, rows, counts, None, self._mbuf)
             else:
-                self._state, io = self._run_io(self._state, rows, counts)
+                self._state, io = run_io(self._state, rows, counts)
+        if timed:
+            # the budget needs the device finished — the one deliberate
+            # sync a budgeted pricing dispatch pays (see ctor comment)
+            jax.block_until_ready(self._state.t)
+            self._pricing_account(T, (time.perf_counter() - t_in) * 1000.0)
         self.ticks_dispatched += T
         self.dispatches += 1
         self._parked_applied += len(parked)
@@ -1009,6 +1052,48 @@ class ServingScheduler(Service):
                 and self.dispatches % self.checkpoint_every == 0):
             self._save_checkpoint()
         return n_jobs
+
+    def _pricing_exec(self):
+        """(executable, timed) for the next dispatch. Untimed fast path
+        unless a pricing budget is armed; under a tripped budget the
+        greedy-matching fallback executable serves, except on re-probe
+        dispatches (every ``pricing_reprobe``) where the solver gets one
+        timed audition to win its seat back. Drive-thread-only state."""
+        if not (self._pricing_armed and self.pricing_budget_ms is not None):
+            return self._run_io, False
+        if self._pricing_fallback:
+            self._pricing_since_probe += 1
+            if self._pricing_since_probe >= self.pricing_reprobe:
+                self._pricing_since_probe = 0
+                return self._run_io, True  # re-probe audition
+            return self._run_io_fallback, False
+        return self._run_io, True
+
+    def _pricing_account(self, T: int, wall_ms: float) -> None:
+        """Judge one timed pricing dispatch against the per-round budget.
+        Rounds in a T-tick window follow the trade cadence (one round per
+        ``monitor_period_ms``, and at least one — the conservative
+        denominator, so a window with zero rounds can never trip)."""
+        rounds = max(T * self.cfg.tick_ms // self.cfg.trader.monitor_period_ms,
+                     1)
+        blown = wall_ms > self.pricing_budget_ms * rounds
+        if blown:
+            self.pricing_fallbacks += 1
+            self.meter.add("pricing_fallbacks", 1)
+            if not self._pricing_fallback:
+                self.logger.warning(
+                    "pricing budget blown: %.2fms for %d round(s) against "
+                    "%.2fms/round — falling back to greedy matching "
+                    "(re-probe every %d dispatches)", wall_ms, rounds,
+                    self.pricing_budget_ms, self.pricing_reprobe)
+            self._pricing_fallback = True
+            self._pricing_since_probe = 0
+        elif self._pricing_fallback:
+            self.logger.info(
+                "pricing re-probe within budget (%.2fms for %d round(s)) "
+                "— solver restored", wall_ms, rounds)
+            self._pricing_fallback = False
+            self._pricing_since_probe = 0
 
     def dispatch_sealed(self) -> int:
         """Dispatch every sealed tick: full coalesce windows first, then
@@ -1154,18 +1239,25 @@ class ServingScheduler(Service):
         bounded at log2(k_cap) even if traffic exceeds the warmed set."""
         import jax.numpy as jnp
         ks = self.warm_k if ks is None else ks
+        execs = [self._run_io]
+        if self._run_io_fallback is not None:
+            # the greedy fallback executable must be warm BEFORE a blown
+            # pricing budget reaches for it — a mid-traffic XLA compile on
+            # the escape path would itself blow the window it rescues
+            execs.append(self._run_io_fallback)
         for K in ks:
             rows = np.broadcast_to(
                 np.asarray(Q._INVALID_ROW),
                 (self.window, self.C, int(K), Q.NF)).copy()
             counts = np.zeros((self.window, self.C), np.int32)
-            clone = jax.tree.map(jnp.copy, self._state)
-            if self.obs:  # warm the executable shape the live path calls
-                mb = jax.tree.map(jnp.copy, self._mbuf)
-                out, _io, _mb = self._run_io(clone, rows, counts, None, mb)
-            else:
-                out, _io = self._run_io(clone, rows, counts)
-            jax.block_until_ready(out.t)  # compile-only: clone discarded
+            for run_io in execs:
+                clone = jax.tree.map(jnp.copy, self._state)
+                if self.obs:  # warm the executable shape the live path calls
+                    mb = jax.tree.map(jnp.copy, self._mbuf)
+                    out, _io, _mb = run_io(clone, rows, counts, None, mb)
+                else:
+                    out, _io = run_io(clone, rows, counts)
+                jax.block_until_ready(out.t)  # compile-only: clone discarded
 
     # ------------------------------------------------------------------
     # drive loop (wall-clock pacing)
@@ -1320,6 +1412,11 @@ class ServingScheduler(Service):
         window."""
         return {
             "policy": self.engine.policy_provenance(),
+            "market": dict(
+                self.engine.market_provenance(),
+                pricing_budget_ms=self.pricing_budget_ms,
+                pricing_fallbacks=self.pricing_fallbacks,
+                pricing_fallback_active=self._pricing_fallback),
             "coalesce_window_ticks": self.window,
             "clusters": self.C, "k_cap": self.k_cap,
             "max_staged": self.max_staged,

@@ -1,0 +1,40 @@
+"""Known-bad solver module — the shapes family 11 must catch: a
+data-dependent ``lax.while_loop`` convergence loop, a Python rejection
+loop over convergence state (which is ALSO a traced branch), and
+host-coerced convergence checks (``float(...)`` residual tests) — the
+run-until-converged idiom the fixed-iteration discipline forbids."""
+import jax
+import jax.numpy as jnp
+
+
+def solve_prices_adaptive(score, lam0, eps):
+    def cond(carry):
+        lam, gap = carry
+        return gap > eps
+
+    def body(carry):
+        lam, _ = carry
+        lam2 = jnp.maximum(lam - 0.1 * jnp.max(score - lam), 0.0)
+        return lam2, jnp.max(jnp.abs(lam2 - lam))
+
+    # BAD: data-dependent trip count — the solve's wall varies per round
+    lam, _ = jax.lax.while_loop(cond, body, (lam0, jnp.float32(1.0)))
+    return lam
+
+
+def match_until_converged(score, lam):
+    gap = jnp.float32(1.0)
+    # BAD: Python rejection loop over convergence state (and the host
+    # float() coercion inside the test syncs the device mid-tick)
+    while float(gap) > 1e-3:
+        lam = jnp.maximum(lam - 0.1, 0.0)
+        gap = jnp.max(jnp.abs(score - lam))
+    return lam
+
+
+def solve_with_host_check(x, eps):
+    r = jnp.sum(x)
+    # BAD: host-coerced convergence check steering a Python branch
+    if float(r) > eps:
+        x = x - 1.0
+    return x

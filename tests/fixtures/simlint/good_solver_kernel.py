@@ -1,0 +1,25 @@
+"""Paired clean solver module: the fixed-iteration shape market/cvx.py
+carries — ``lax.scan`` over a static trip count, active depth masked by
+a traced hyperparameter leaf, convergence never checked on the host."""
+import jax
+import jax.numpy as jnp
+
+
+def solve_prices(score, lam0, n_iters, iters_active):
+    def step(carry, i):
+        lam = carry
+        act = i < iters_active  # masked active depth, traced & sweepable
+        g = score - lam[None, :]
+        x = jnp.clip(2.0 * g, 0.0, 1.0)
+        col = jnp.sum(x, axis=0) - 1.0
+        rho_i = 1.0 / (1.0 + i.astype(jnp.float32))
+        lam2 = jnp.maximum(lam + rho_i * jnp.clip(col, -1.0, 1.0), 0.0)
+        return jnp.where(act, lam2, lam), None
+
+    lam, _ = jax.lax.scan(step, lam0, jnp.arange(n_iters, dtype=jnp.int32))
+    return lam
+
+
+def match_plan(score, lam):
+    x = jnp.clip(2.0 * (score - lam[None, :]), 0.0, 1.0)
+    return jnp.argmax(x, axis=1).astype(jnp.int32)

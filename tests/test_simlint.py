@@ -70,6 +70,7 @@ FIXTURE_RULES = [
     ("bad_compact_store.py", "compact-store"),
     ("bad_policy_kernel.py", "policy-kernel"),
     ("bad_pallas_kernel.py", "pallas-kernel"),
+    ("bad_solver_kernel.py", "solver-kernel"),
     ("bad_env_rng.py", "env-rng"),
     ("bad_shard_exchange.py", "shard-exchange"),
     ("bad_serve_sync.py", "serve-sync"),
@@ -222,6 +223,79 @@ def test_pallas_kernel_scopes_the_kernels_package():
               if m.relpath.split("/", 1)[0] in PALLAS_KERNEL_DIRS]
     assert any(m.relpath == "kernels/fused_tick.py" for m in scoped), \
         "kernels/fused_tick.py not loaded — the pallas-kernel scope is empty"
+
+
+def test_bad_solver_kernel_flags_every_violation_shape():
+    """The fixture carries the three run-until-converged idioms — a
+    data-dependent lax.while_loop, a Python rejection loop over
+    convergence state, and host-coerced convergence checks — surfacing
+    as six findings: the while_loop, the Python `while` (flagged both by
+    the family rule and as a traced branch), its float() coercion, and
+    the host-checked `if` (traced branch + coercion)."""
+    findings = [f for f in run(str(FIXTURES / "bad_solver_kernel.py"))
+                if f.rule == "solver-kernel"]
+    assert len(findings) == 6, "\n".join(f.render() for f in findings)
+
+
+def test_good_solver_kernel_fixture_is_clean():
+    """The paired clean solver — lax.scan over a static trip count with
+    the active depth masked by a traced hyperparameter leaf, the
+    market/cvx.py shape — must NOT trip solver-kernel (or anything
+    else)."""
+    findings = run(str(FIXTURES / "good_solver_kernel.py"))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    proc = _cli(str(FIXTURES / "good_solver_kernel.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_solver_kernel_reaches_the_real_cvx_kernel(tmp_path):
+    """solver-kernel provably engages with market/cvx.py's real solve:
+    replace the fixed-iteration lax.scan entry with a convergence-tested
+    lax.while_loop and the rule must fire — so the package analyzing
+    clean can never mean 'checked nothing'."""
+    src = (PKG_DIR / "market" / "cvx.py").read_text()
+    anchor = "    (x, lam, _), _ = jax.lax.scan(step, (x0, lam0, mu0),\n"
+    bad = src.replace(
+        anchor,
+        "    lam0 = jax.lax.while_loop(lambda l: jnp.max(l) > 0.5,\n"
+        "                              lambda l: l * 0.5, lam0)\n" + anchor,
+        1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "cvx_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "solver-kernel" for x in run(str(f)))
+
+
+def test_solver_kernel_flags_host_convergence_check_in_real_trader(tmp_path):
+    """The host-coercion half against the real matcher module: a
+    float()-checked convergence test pasted into trader's sinkhorn loop
+    must fire even though the matchers dispatch through lax.switch
+    tables (the jit-entry reachability blind spot this family exists
+    for)."""
+    src = (PKG_DIR / "market" / "trader.py").read_text()
+    anchor = "def _match_sinkhorn("
+    bad = src.replace(
+        anchor,
+        "def _solve_converged(resid):\n"
+        "    if float(jnp.max(resid)) > 1e-3:\n"
+        "        return True\n"
+        "    return False\n\n\n" + anchor, 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "trader_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "solver-kernel" for x in run(str(f)))
+
+
+def test_solver_kernel_scopes_the_market_package():
+    """The family actually runs over market/ inside the package (a clean
+    result must mean 'checked and clean', not 'not in scope')."""
+    from tools.simlint.runner import SOLVER_KERNEL_DIRS
+
+    modules, _ = load_target(str(PKG_DIR))
+    scoped = [m for m in modules
+              if m.relpath.split("/", 1)[0] in SOLVER_KERNEL_DIRS]
+    assert any(m.relpath == "market/cvx.py" for m in scoped), \
+        "market/cvx.py not loaded — the solver-kernel scope is empty"
 
 
 def test_bad_env_rng_flags_every_violation_shape():

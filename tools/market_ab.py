@@ -1,14 +1,17 @@
 #!/usr/bin/env python
-"""Sinkhorn vs greedy matcher A/B on the sinkhorn bench shape.
+"""Greedy vs sinkhorn vs cvx matcher A/B on the sinkhorn bench shape.
 
 Same workload, same engine, same config except ``trader.matching``:
 half the clusters are gpu-rich sellers, half gpu-poor buyers whose gpu
 jobs can only run on traded virtual nodes, at ~1.1x capacity saturation
 (the bench_sinkhorn shape, bench.py). Records, per matcher and cluster
 count: jobs placed (fraction), virtual nodes traded, mean avg-wait over
-clusters, and wall — the quantified basis for MARKET.md's claim that the
-entropic-OT matcher is (or is not) an upgrade over the reference's
-cheapest-approving-seller heap (trader.go:169-191,236-276).
+clusters, wall, and the engine's market provenance — the quantified
+basis for MARKET.md's claims that the entropic-OT matcher is an upgrade
+over the reference's cheapest-approving-seller heap
+(trader.go:169-191,236-276) and that the cvx dual-ascent kernel
+(market/cvx.py) matches-or-beats sinkhorn on placed + mean wait (the
+ISSUE-16 acceptance gate; --require-cvx-wins enforces it, exit 1).
 
 Run on the TPU: ``python tools/market_ab.py [--clusters 1024 4096]``.
 Writes a markdown table to stdout and JSON to tools/market_ab.json.
@@ -62,16 +65,25 @@ def run_one(matching: str, C: int):
             "mean_avg_wait_ms": round(float(waits.mean()), 1),
             "p95_avg_wait_ms": round(float(np.percentile(waits, 95)), 1),
             "wall_s": round(wall, 3), "walls": walls_r,
-            "timing": f"min-of-{len(walls_r)}", "drops": drops}
+            "timing": f"min-of-{len(walls_r)}", "drops": drops,
+            "market": eng.market_provenance()}
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--clusters", type=int, nargs="+", default=[1024, 4096])
+    ap.add_argument("--matchers", nargs="+",
+                    default=["greedy", "sinkhorn", "cvx"],
+                    choices=("greedy", "sinkhorn", "cvx"))
+    ap.add_argument("--require-cvx-wins", action="store_true",
+                    help="exit 1 unless, at every cluster count, cvx "
+                         "matches-or-beats sinkhorn on BOTH placed jobs "
+                         "and mean avg wait (the ISSUE-16 acceptance "
+                         "gate)")
     args = ap.parse_args()
     rows = []
     for C in args.clusters:
-        for m in ("greedy", "sinkhorn"):
+        for m in args.matchers:
             r = run_one(m, C)
             rows.append(r)
             print(f"# {m}@{C}: placed {r['placed_frac']:.4f}, "
@@ -89,6 +101,23 @@ def main():
         print(f"| {r['clusters']} | {r['matching']} | {r['placed_frac']} | "
               f"{r['virtual_nodes_traded']} | {r['mean_avg_wait_ms']} | "
               f"{r['p95_avg_wait_ms']} | {r['wall_s']} |")
+    if args.require_cvx_wins:
+        by = {(r["clusters"], r["matching"]): r for r in rows}
+        failed = []
+        for C in args.clusters:
+            cvx, sink = by.get((C, "cvx")), by.get((C, "sinkhorn"))
+            if cvx is None or sink is None:
+                failed.append(f"{C}: need both cvx and sinkhorn rows")
+            elif (cvx["placed"] < sink["placed"]
+                  or cvx["mean_avg_wait_ms"] > sink["mean_avg_wait_ms"]):
+                failed.append(
+                    f"{C}: cvx placed {cvx['placed']} wait "
+                    f"{cvx['mean_avg_wait_ms']}ms vs sinkhorn "
+                    f"{sink['placed']}/{sink['mean_avg_wait_ms']}ms")
+        if failed:
+            print("FAIL --require-cvx-wins: " + "; ".join(failed),
+                  file=sys.stderr)
+            sys.exit(1)
 
 
 if __name__ == "__main__":

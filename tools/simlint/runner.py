@@ -8,6 +8,7 @@ from typing import Iterable, Optional
 from tools.simlint import (
     compactstore, determinism, envrng, findings as F, lockset, obstap,
     pallaskernel, policykernel, purity, servesync, shardexchange,
+    solverkernel,
 )
 from tools.simlint.callgraph import CallGraph
 from tools.simlint.project import Module, in_scope, load_target
@@ -58,6 +59,13 @@ OBS_TAP_RULES = ("obs-tap",)
 # ref block-indexing discipline and the interpret-from-config obligation
 PALLAS_KERNEL_DIRS = ("kernels",)
 PALLAS_KERNEL_RULES = ("pallas-kernel",)
+# the pricing solvers (ISSUE 16): market/'s matchers dispatch through
+# lax.switch tables (the same jit-entry blind spot as the policy zoo), so
+# the purity node checks apply to every function, plus the fixed-iteration
+# obligation — no data-dependent lax.while_loop / Python rejection loops /
+# host-coerced convergence checks inside the trade round
+SOLVER_KERNEL_DIRS = ("market",)
+SOLVER_KERNEL_RULES = ("solver-kernel",)
 # serving-tier handler discipline (ISSUE 11): no blocking device syncs in
 # HTTP/gRPC handler scope — handlers stage and read snapshots only; the
 # per-request reference hosts are sanctioned inside the pass (they ARE the
@@ -66,7 +74,8 @@ SERVE_SYNC_DIRS = ("services",)
 SERVE_SYNC_RULES = ("serve-sync",)
 PRAGMA_RULES = ("pragma-no-reason", "pragma-stale")
 ALL_RULES = (PURITY_RULES + LOCKSET_RULES + DET_RULES + COMPACT_RULES
-             + POLICY_KERNEL_RULES + PALLAS_KERNEL_RULES + ENV_RNG_RULES
+             + POLICY_KERNEL_RULES + PALLAS_KERNEL_RULES
+             + SOLVER_KERNEL_RULES + ENV_RNG_RULES
              + SHARD_EXCHANGE_RULES + SERVE_SYNC_RULES + OBS_TAP_RULES
              + PRAGMA_RULES)
 
@@ -105,6 +114,10 @@ def run(target: str, rules: Optional[Iterable[str]] = None,
                 mod.relpath != "" or pallaskernel.module_is_pallas(mod)):
             raw += pallaskernel.check_module(mod)
             checked.update(PALLAS_KERNEL_RULES)
+        if in_scope(mod, SOLVER_KERNEL_DIRS) and (
+                mod.relpath != "" or solverkernel.module_is_solver(mod)):
+            raw += solverkernel.check_module(mod)
+            checked.update(SOLVER_KERNEL_RULES)
         if in_scope(mod, ENV_RNG_DIRS) and (
                 mod.relpath != "" or envrng.module_is_env(mod)):
             raw += envrng.check_module(mod)

@@ -1,0 +1,122 @@
+"""solver-kernel pass (rule family 11): fixed-iteration solver discipline.
+
+Everything under ``market/`` prices the trade round inside the jitted
+tick — the matchers (greedy heap, sinkhorn OT, cvx dual ascent) dispatch
+through ``lax.switch``/``lax.cond`` tables in ``trader._round``, the same
+call-graph blind spot as the policy zoo and the Pallas kernel bodies.
+Three obligations, one family rule id ``solver-kernel`` (LINTING.md §11):
+
+- **Fixed iteration counts, machine-checked.** An iterative pricing
+  solver inside the tick must run a STATIC trip count (``lax.scan`` over
+  ``arange(n_iters)``, active depth masked by a traced ``hp`` leaf —
+  market/cvx.py's shape). A data-dependent ``lax.while_loop`` is the
+  PR-7 rejection-sampler bug wearing a solver costume: the trip count
+  varies with the data, so the executable's wall varies per round (the
+  serving tick budget can't be sized), replay across chunkings diverges
+  (a chunk boundary lands mid-solve under one chunking and not another),
+  and donated-buffer layouts can't be planned. ``lax.fori_loop`` with a
+  traced bound is the same bug (XLA lowers it to a while), so any
+  ``while_loop`` call in solver scope is a finding, full stop.
+
+- **No Python rejection loops.** A host-level ``while`` in a solver
+  module is either dead under jit (it would have thrown on a traced
+  condition) or — worse — it runs at TRACE time and bakes a
+  data-dependent number of solver iterations into the compiled program
+  (the "converged on the example input" bug: the program replays with
+  the trace input's iteration count forever). Solver modules get no
+  Python loops over convergence state; ``lax.scan`` is the loop.
+
+- **Purity, unconditionally.** Because the matchers escape jit-entry
+  reachability, the purity node checks (traced branches, wall-clock/RNG,
+  host coercions, bare ``np.`` on traced data, 64-bit dtypes) apply to
+  EVERY function in the module, reachable or not. The canonical catch:
+  a host-coerced convergence check (``float(residual) < eps`` /
+  ``np.asarray(gap)``) that syncs the device mid-tick and makes the
+  "solved" decision on the host — the exact shape the fixed-iteration
+  design exists to forbid.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint import purity
+from tools.simlint.callgraph import dotted_name
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+
+def module_is_solver(mod: Module) -> bool:
+    """Single-file scoping heuristic (fixtures): does the module define a
+    solver-shaped function (``solve*`` / ``match*`` after stripping
+    leading underscores)? Package runs scope by directory (``market/``)
+    instead, so the heuristic only has to recognize standalone solver
+    modules — not every file that merely imports one."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            name = node.name.lstrip("_")
+            if name.startswith("solve") or name.startswith("match"):
+                return True
+    return False
+
+
+def _loop_findings(mod: Module) -> set:
+    """Data-dependent iteration in solver scope: any ``while_loop`` call
+    (``lax.while_loop`` / ``jax.lax.while_loop`` / a bare import) and any
+    Python ``while`` statement."""
+    found = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            d = (dotted_name(node.func) or "").split(".")[-1]
+            if d == "while_loop":
+                found.add((node.lineno, "solver-kernel",
+                           "lax.while_loop in solver scope: an iterative "
+                           "pricing solve must run a STATIC trip count "
+                           "(lax.scan over arange(n_iters), active depth "
+                           "masked by a traced hp leaf — market/cvx.py) — "
+                           "a data-dependent trip count breaks the serving "
+                           "tick's wall budget and chunk-boundary replay"))
+        elif isinstance(node, ast.While):
+            found.add((node.lineno, "solver-kernel",
+                       "Python `while` in a solver module: under jit this "
+                       "either throws on a traced condition or runs at "
+                       "trace time and bakes the example input's iteration "
+                       "count into the compiled program — use lax.scan "
+                       "with a static trip count"))
+    return found
+
+
+def check_module(mod: Module) -> list[Finding]:
+    raw: set[tuple] = set()
+    np_aliases = purity._np_alias_set(mod)
+    random_aliases = frozenset(
+        {a for a, m in mod.module_aliases.items() if m == "random"} | {
+            a for a, (src, orig) in mod.from_imports.items()
+            if src == "numpy" and orig == "random"})
+
+    # every top-level function and method — the matchers dispatch through
+    # lax.switch tables, so reachability can't scope this; nested defs
+    # (scan bodies) are walked as part of their parent (same traced
+    # program)
+    def visit(node, inside_fn):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not inside_fn:
+                    tainter = purity._Tainter(child)
+                    # the exchange handle and static market config carry
+                    # host-side plumbing (axis names, cadence ints)
+                    for static in ("ex", "mcfg", "cfg"):
+                        if static in tainter.env:
+                            tainter.env[static] = False
+                    for n in ast.walk(child):
+                        purity._check_node(n, tainter, np_aliases,
+                                           random_aliases, raw)
+                visit(child, True)
+            else:
+                visit(child, inside_fn)
+
+    visit(mod.tree, False)
+    raw.update(_loop_findings(mod))
+    return [Finding(mod.path, line, "solver-kernel",
+                    (msg if rule == "solver-kernel" else f"[{rule}] {msg}"))
+            for (line, rule, msg) in sorted(raw)]
