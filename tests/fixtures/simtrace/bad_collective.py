@@ -1,0 +1,40 @@
+"""simtrace fixture: a rogue collective.
+
+A raw ``lax.psum`` inside a shard_map body, never routed through
+``parallel/exchange.py`` — the dynamic-dispatch hole AST family 7 cannot
+see (the call site here IS visible, but a vendored copy of the helpers
+would look identical to the AST while the jaxpr frames give it away).
+The collective audit must attribute the psum eqn to THIS file and flag it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from multi_cluster_simulator_tpu.parallel.sharded_engine import (
+    _SHARD_MAP_KW, _shard_map,
+)
+from tools.simtrace.registry import Built, EntryPoint
+
+
+def _build():
+    mesh = Mesh(np.array(jax.devices()[:1]), ("clusters",))
+
+    def body(x):
+        return jax.lax.psum(x, "clusters")  # rogue: not via Exchange
+
+    fn = jax.jit(_shard_map(body, mesh=mesh, in_specs=(P("clusters"),),
+                            out_specs=P(), **_SHARD_MAP_KW))
+
+    def fresh(v):
+        return (jnp.full((4,), float(v), jnp.float32),)
+
+    return Built(fn=fn, fresh_args=fresh)
+
+
+ENTRIES = [
+    EntryPoint("bad.collective", _build,
+               description="raw psum outside parallel/exchange.py"),
+]

@@ -1,0 +1,42 @@
+"""simtrace fixture: both donation failure modes.
+
+``bad.donation_lost`` declares a donated state but its jit never requests
+donation (the dropped-``donate_argnums`` regression). ``bad.donation_unusable``
+requests donation for a buffer no output can alias (shape mismatch) — XLA
+silently drops it with a stderr warning nobody reads; the audit must turn
+both into findings.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from tools.simtrace.registry import Built, EntryPoint
+
+
+def _build_lost():
+    fn = jax.jit(lambda s, x: s + x)  # donate_argnums dropped
+
+    def fresh(v):
+        return (jnp.full((8, 8), float(v), jnp.float32),
+                jnp.ones((8, 8), jnp.float32))
+
+    return Built(fn=fn, fresh_args=fresh, donated=(0,),
+                 pick_state_out=lambda o: o)
+
+
+def _build_unusable():
+    # the (8, 8) f32 input cannot alias the scalar output -> XLA drops it
+    fn = jax.jit(lambda s: jnp.sum(s), donate_argnums=(0,))
+
+    def fresh(v):
+        return (jnp.full((8, 8), float(v), jnp.float32),)
+
+    return Built(fn=fn, fresh_args=fresh, donated=(0,))
+
+
+ENTRIES = [
+    EntryPoint("bad.donation_lost", _build_lost,
+               description="declared donation never requested"),
+    EntryPoint("bad.donation_unusable", _build_unusable,
+               description="requested donation XLA cannot use"),
+]

@@ -1,0 +1,45 @@
+"""simtrace fixture: 64-bit leaks the dtype audit must flag.
+
+``bad.dtype_input`` builds its argument with a dtype-less np.arange — under
+x64 the input aval is int64 (the dropped-``np.int32`` builder regression).
+``bad.dtype_carry`` scans with a weak-int carry that widens to int64 under
+x64 — persistent storage, the width class the compact plan exists to pin.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tools.simtrace.registry import Built, EntryPoint
+
+
+def _build_input():
+    fn = jax.jit(lambda x: x * 2)
+
+    def fresh(v):
+        return (np.arange(16) + v,)  # no dtype: i64 under x64
+
+    return Built(fn=fn, fresh_args=fresh)
+
+
+def _build_carry():
+    def step(x):
+        def body(c, _):
+            return c + 1, c
+        c, ys = jax.lax.scan(body, jnp.asarray(0), None, length=4)
+        return x + ys.astype(jnp.float32).sum() + c
+
+    fn = jax.jit(step)
+
+    def fresh(v):
+        return (jnp.full((4,), float(v), jnp.float32),)
+
+    return Built(fn=fn, fresh_args=fresh)
+
+
+ENTRIES = [
+    EntryPoint("bad.dtype_input", _build_input,
+               description="dtype-less arange argument"),
+    EntryPoint("bad.dtype_carry", _build_carry,
+               description="weak-int scan carry widens under x64"),
+]

@@ -1,0 +1,27 @@
+"""simtrace fixture: a value-dependent trace path.
+
+The step bakes a per-call Python value into the trace via static_argnums
+— the canonical broken-K-bucketing shape (serving._pick_k without the
+pow2 ladder): every distinct value compiles a fresh executable, and the
+retrace audit must see the jit cache grow across two value-distinct,
+shape-equivalent calls.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from tools.simtrace.registry import Built, EntryPoint
+
+
+def _build():
+    fn = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+
+    def fresh(v):
+        return (jnp.ones((8,), jnp.float32), 2 + v)  # value varies -> retrace
+
+    return Built(fn=fn, fresh_args=fresh, static_argnums=(1,))
+
+
+ENTRIES = [
+    EntryPoint("bad.retrace", _build, description="value-baked static arg"),
+]

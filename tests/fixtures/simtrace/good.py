@@ -1,0 +1,27 @@
+"""simtrace fixture: a clean entry — every check passes.
+
+The paired-good half of the fixture family (the simlint convention): one
+donating jitted step whose donation aliases, whose trace is value-stable,
+whose dtypes are pinned, and which runs no collectives.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from tools.simtrace.registry import Built, EntryPoint
+
+
+def _build():
+    fn = jax.jit(lambda s, x: (s + x, jnp.sum(x)), donate_argnums=(0,))
+
+    def fresh(v):
+        return (jnp.full((8, 8), float(v), jnp.float32),
+                jnp.full((8, 8), float(v + 1), jnp.float32))
+
+    return Built(fn=fn, fresh_args=fresh, donated=(0,),
+                 pick_state_out=lambda o: o[0])
+
+
+ENTRIES = [
+    EntryPoint("good.step", _build, description="clean donating step"),
+]

@@ -1,0 +1,447 @@
+"""simtrace: the jaxpr/compiled-program auditor gate (tier-1).
+
+(a) each check is pinned against its bad fixture registry through the real
+    CLI (exit 1 + the exact finding), and the good fixture passes clean;
+(b) every check has an injected-regression test that breaks a COPY of real
+    project code — the dropped ``donate_argnums``, the un-bucketed chunk K,
+    the dropped ``astype(np.int32)`` trace builder, a vendored collective
+    helper, a widened metrics ring — and the audit must catch the copy
+    (a check that only rejects toy fixtures proves nothing about drivers);
+(c) the byte-budget plumbing: committed budgets cover every registered
+    entry, the sha256 gate catches hand-edits, and a budget drifted past
+    the tolerance band fails the CLI by name;
+(d) the waiver policy (simlint's pragma policy verbatim): reasonless
+    waivers and waivers that suppress nothing are themselves findings.
+
+Unlike test_simlint.py this file imports jax — the auditor's subject is
+the traced/compiled program, not the AST.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "multi_cluster_simulator_tpu"
+FIXTURES = Path(__file__).parent / "fixtures" / "simtrace"
+
+sys.path.insert(0, str(REPO))  # tools/ is repo-rooted
+
+from tools.simtrace import budgets as B  # noqa: E402
+from tools.simtrace import checks as C  # noqa: E402
+from tools.simtrace import entrypoints as E  # noqa: E402
+from tools.simtrace.registry import (  # noqa: E402
+    Built, EntryPoint, Finding, Waiver, load_registry,
+)
+from tools.simtrace.runner import (  # noqa: E402
+    ALL_CHECKS, _apply_waivers, audit_entry, run_registry,
+)
+
+
+def _cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tools.simtrace", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=420)
+
+
+def _copy_module(tmp_path, src: Path, name: str, old: str = None,
+                 new: str = None):
+    """Load a (optionally patched) copy of a real project module from an
+    unsanctioned tmp path. Asserts the patch anchor exists — a vanished
+    anchor would make the injected-regression test silently vacuous."""
+    text = src.read_text(encoding="utf-8")
+    if old is not None:
+        assert old in text, f"patch anchor vanished from {src}: {old!r}"
+        text = text.replace(old, new, 1)
+    path = tmp_path / f"{name}.py"
+    path.write_text(text, encoding="utf-8")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# (a) fixture pairs through the real CLI
+# ---------------------------------------------------------------------------
+
+BAD_FIXTURES = [
+    ("bad_retrace.py", "retrace", "jit cache holds"),
+    ("bad_donation.py", "donation", "never requested"),
+    ("bad_dtype.py", "dtype", "input aval"),
+    ("bad_collective.py", "collective", "does not trace to"),
+]
+
+
+@pytest.mark.parametrize("fixture,check,needle", BAD_FIXTURES)
+def test_cli_rejects_bad_fixture(fixture, check, needle):
+    proc = _cli("--registry", str(FIXTURES / fixture), "--checks", check)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert needle in proc.stdout, proc.stdout
+
+
+def test_cli_passes_good_fixture():
+    # bytes is excluded: the fixture has no committed budget by design
+    # (the bytes gate's good/bad pair is the drift test below)
+    proc = _cli("--registry", str(FIXTURES / "good.py"),
+                "--checks", "retrace", "donation", "dtype", "collective")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_bad_donation_catches_both_failure_modes():
+    entries = load_registry(str(FIXTURES / "bad_donation.py"))
+    findings, _, _ = run_registry(entries, ("donation",))
+    msgs = [f.message for f in findings]
+    assert any("never requested" in m for m in msgs), msgs
+    assert any("NOT aliased" in m or "warned" in m for m in msgs), msgs
+
+
+def test_bad_dtype_catches_input_and_carry():
+    entries = load_registry(str(FIXTURES / "bad_dtype.py"))
+    findings, _, _ = run_registry(entries, ("dtype",))
+    msgs = [f.message for f in findings]
+    assert any("int64" in m and "input aval" in m for m in msgs), msgs
+    assert any("carried through scan" in m for m in msgs), msgs
+
+
+# ---------------------------------------------------------------------------
+# (b) injected regressions against copies of real project code
+# ---------------------------------------------------------------------------
+
+def test_injected_donation_dropped_from_engine_copy(tmp_path):
+    """Copy core/engine.py with run_io_jit's donate_argnums dropped — the
+    exact silent regression the audit exists for: the driver still says
+    donate=True, the jit just stops forwarding it."""
+    mod = _copy_module(
+        tmp_path, PKG / "core" / "engine.py", "engine_donation_copy",
+        old=("return jax.jit(self.run_io,\n"
+             "                       donate_argnums=(0,) if donate else ())"),
+        new="return jax.jit(self.run_io)")
+    cfg, specs = E._quick_cfg(), E._specs()
+    fn = mod.Engine(cfg).run_io_jit(donate=True)  # donation silently lost
+
+    def fresh(v):
+        ta = E._ticks(v, cfg=cfg)
+        return (E._fresh_state(cfg, specs), ta.rows, ta.counts)
+
+    built = Built(fn=fn, fresh_args=fresh, donated=(0,),
+                  pick_state_out=lambda o: o[0])
+    findings = C.check_donation(
+        EntryPoint("injected.donation", lambda: built), built)
+    assert any("never requested" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_injected_retrace_unbucketed_chunks_from_engine_copy(tmp_path):
+    """Copy core/engine.py with round_up_pow2 neutered — per-chunk K then
+    tracks the data instead of the pow2 bucket (clamped at the stream
+    max), and two value-distinct streams compile twice. The unpatched
+    packer at the same streams is the control: one compile, audit clean.
+
+    Shape of the stream: chunk 0 carries the stream-global max (8 arrivals
+    in one tick) so ``k_global`` is 8 for both variants; chunk 1 — the
+    chunk the audited jit consumes — carries 5 vs 7, which the real pow2
+    bucket rounds to the same K=8 and the broken identity bucket leaves
+    as two distinct shapes."""
+    from multi_cluster_simulator_tpu.core.engine import (
+        Engine, pack_arrivals_chunks,
+    )
+    from multi_cluster_simulator_tpu.core.state import Arrivals
+    mod = _copy_module(
+        tmp_path, PKG / "core" / "engine.py", "engine_retrace_copy",
+        old="return 1 << max(int(k) - 1, 0).bit_length()",
+        new="return int(k)")
+    n, T = 2, 4
+    cfg, specs = E._quick_cfg(), E._specs(n)
+
+    def arrivals(n_jobs):  # 8 jobs at tick 0, n_jobs at tick T per cluster
+        A = 16
+        t = np.zeros((n, A), np.int32)
+        # dest tick is ceil(t / tick_ms) - 1: this lands in tick T, the
+        # first tick of chunk 1
+        t[:, 8:] = (T + 1) * cfg.tick_ms
+        full = lambda v: np.full((n, A), v, np.int32)
+        ids = np.tile(np.arange(A, dtype=np.int32), (n, 1))
+        return Arrivals(t=t, id=ids,
+                        cores=full(2), mem=full(100), gpu=full(0),
+                        dur=full(1_000),
+                        n=np.full((n,), 8 + n_jobs, np.int32))
+
+    def fresh_with(packer):
+        def fresh(v):
+            ta = packer(arrivals(5 if v == 0 else 7), (T, T),
+                        cfg.tick_ms)[1]
+            return (E._fresh_state(cfg, specs), ta.rows, ta.counts)
+        return fresh
+
+    control = Built(fn=Engine(cfg).run_io_jit(),
+                    fresh_args=fresh_with(pack_arrivals_chunks))
+    assert C.check_retrace(
+        EntryPoint("control.retrace", lambda: control), control) == []
+
+    broken = Built(fn=Engine(cfg).run_io_jit(),
+                   fresh_args=fresh_with(mod.pack_arrivals_chunks))
+    findings = C.check_retrace(
+        EntryPoint("injected.retrace", lambda: broken), broken)
+    assert any("jit cache holds 2" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_injected_dtype_dropped_astype_from_traces_copy(tmp_path):
+    """Copy workload/traces.py with _pack's ``.astype(np.int32)`` dropped —
+    the stream builder then hands i64 arrays to the jit under x64, exactly
+    the width regression the compact plan exists to pin. The real builder
+    at the same shape is the control."""
+    import jax
+    import jax.numpy as jnp
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+    mod = _copy_module(
+        tmp_path, PKG / "workload" / "traces.py", "traces_dtype_copy",
+        old="np.take_along_axis(a, order, axis=1).astype(np.int32)",
+        new="np.take_along_axis(a, order, axis=1)")
+
+    def cell(stream_fn):
+        # f32 reduction: the audited width is the Arrivals storage itself,
+        # not jnp.sum's numpy-semantics i64 accumulator under x64
+        fn = jax.jit(lambda a: jnp.sum(a.cores.astype(jnp.float32))
+                     + jnp.sum(a.t.astype(jnp.float32)))
+
+        def fresh(v):
+            return (stream_fn(2, jobs_per_cluster=8, horizon_ms=4_000,
+                              max_cores=4, max_mem=100, max_dur_ms=1_000,
+                              seed=v),)
+        return Built(fn=fn, fresh_args=fresh)
+
+    control = cell(uniform_stream)
+    assert C.check_dtype(
+        EntryPoint("control.dtype", lambda: control), control) == []
+
+    broken = cell(mod.uniform_stream)
+    findings = C.check_dtype(
+        EntryPoint("injected.dtype", lambda: broken), broken)
+    assert any("int64" in f.message and "input aval" in f.message
+               for f in findings), [f.render() for f in findings]
+
+
+def test_injected_collective_vendored_exchange_copy(tmp_path):
+    """A verbatim copy of parallel/exchange.py living outside the
+    sanctioned path IS the regression — its call sites look identical to
+    the AST (simlint family 7's blind spot), but the jaxpr frames attribute
+    every collective to the vendored file and the audit must flag it."""
+    import jax
+    from jax.sharding import Mesh
+
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.parallel.sharded_engine import (
+        ShardedEngine,
+    )
+    mod = _copy_module(tmp_path, PKG / "parallel" / "exchange.py",
+                       "vendored_exchange")
+    # borrowing ON so the traced program actually carries collectives
+    # (the production sharded entry's config, for the same reason)
+    cfg, specs = E._quick_cfg(borrowing=True, max_virtual_nodes=2), E._specs()
+    mesh = Mesh(np.array(jax.devices()[:2]), ("clusters",))
+    se = ShardedEngine(cfg, mesh)
+    se.engine = Engine(cfg, ex=mod.MeshExchange("clusters"))  # vendored
+    fn = se.run_fn(n_ticks=E.T, tick_indexed=True)
+
+    def fresh(v):
+        return se.shard_inputs(E._fresh_state(cfg, specs),
+                               E._ticks(v, cfg=cfg))
+
+    built = Built(fn=fn, fresh_args=fresh)
+    findings = C.check_collective(
+        EntryPoint("injected.collective", lambda: built), built)
+    assert findings, "vendored collectives were not flagged"
+    assert any("vendored_exchange" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_injected_bytes_widened_ring_from_obs_copy(tmp_path):
+    """Copy obs/device.py with OBS_RING widened 64 -> 4096 and rebuild the
+    serving.dispatch cell around the fat metrics plane — the measured
+    buffer-boundary bytes must blow the committed budget's ±5% band. This
+    is the CI byte-budget gate firing on a synthetic widening."""
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    mod = _copy_module(tmp_path, PKG / "obs" / "device.py", "obs_wide_copy",
+                       old="OBS_RING = 64", new="OBS_RING = 4096")
+    n = 2
+    cfg, specs = E._quick_cfg(), E._specs(n)
+    fn = Engine(cfg).run_io_jit(donate=True)
+
+    def fresh(v):
+        state = E._fresh_state(cfg, specs)
+        ta = E._ticks(v, n, cfg=cfg)
+        return (state, ta.rows[:4], ta.counts[:4], None,
+                mod.metrics_init(state))
+
+    built = Built(fn=fn, fresh_args=fresh, donated=(0,),
+                  pick_state_out=lambda o: o[0])
+    entry = EntryPoint("injected.bytes", lambda: built,
+                       budget_key="serving.dispatch")
+    measured = C.measure_bytes(entry, built)
+    if measured is None:
+        pytest.skip("this jax build has no Compiled.memory_analysis")
+    row = B.load()["entries"]["serving.dispatch"]
+    findings = C.check_bytes(entry, measured, row)
+    assert any("above" in f.message and "committed budget" in f.message
+               for f in findings), [f.render() for f in findings]
+
+
+def test_production_sharded_entry_traces_sanctioned_collectives():
+    """Non-vacuity: the registered sharded entry's program must CONTAIN
+    collectives (borrowing rides the mesh exchange), and every one of them
+    must be attributed to the sanctioned modules — 'clean' here can never
+    mean 'there was nothing to check'."""
+    import jax
+
+    entry = next(e for e in load_registry("tools.simtrace.entrypoints")
+                 if e.name == "sharded.run_fn")
+    if jax.device_count() < entry.devices:
+        pytest.skip("needs a multi-device mesh")
+    built = entry.build()
+    jaxpr = jax.make_jaxpr(
+        built.fn, static_argnums=built.static_argnums)(*built.fresh_args(0))
+    prims = {eqn.primitive.name for eqn in C.iter_eqns(jaxpr.jaxpr)}
+    assert prims & C.COLLECTIVE_PRIMS, sorted(prims)
+    assert C.check_collective(entry, built) == []
+
+
+# ---------------------------------------------------------------------------
+# (c) byte budgets: coverage, hash gate, drift gate
+# ---------------------------------------------------------------------------
+
+def test_committed_budgets_cover_every_registered_entry():
+    assert B.verify_hash() == []
+    committed = B.load()
+    entries = load_registry("tools.simtrace.entrypoints")
+    for e in entries:
+        row = committed["entries"].get(e.budget)
+        assert row, f"no committed budget for {e.budget}"
+        assert row["bytes"] > 0 and "devices" in row and "shape" in row
+    prov = committed["provenance"]
+    assert prov["backend"] and prov["devices"] and prov["registry"]
+
+
+def test_budget_hash_gate_catches_hand_edit(tmp_path):
+    payload = B.load()
+    payload["entries"]["engine.run"]["bytes"] += 4  # no re-hash: hand-edit
+    p = tmp_path / "budgets.json"
+    p.write_text(json.dumps(payload, indent=2), encoding="utf-8")
+    errs = B.verify_hash(p)
+    assert errs and "hash mismatch" in errs[0], errs
+    proc = _cli("--check-budget-hash", "--budgets", str(p))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "hash mismatch" in proc.stdout
+
+
+def test_budget_drift_fails_cli_by_name(tmp_path):
+    """End-to-end over the good fixture: earn a budget, pass the gate,
+    then shrink the committed number WITH a valid re-hash — the drift gate
+    (not the hash gate) must fail the run and name the entry."""
+    reg = str(FIXTURES / "good.py")
+    bpath = str(tmp_path / "budgets.json")
+    proc = _cli("--registry", reg, "--update-budgets", "--budgets", bpath,
+                "--checks", "bytes")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    proc = _cli("--registry", reg, "--checks", "bytes", "--budgets", bpath)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    payload = B.load(bpath)
+    payload["entries"]["good.step"]["bytes"] *= 2
+    B.save(payload, bpath)  # hash valid: only the drift gate can catch it
+    proc = _cli("--registry", reg, "--checks", "bytes", "--budgets", bpath)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "good.step" in proc.stdout and "below" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# (d) waiver policy + registry/runner mechanics
+# ---------------------------------------------------------------------------
+
+def _waiver_entry(*waivers):
+    return EntryPoint("w.entry", lambda: None, waivers=tuple(waivers))
+
+
+def test_waiver_with_reason_suppresses():
+    f = Finding("w.entry", "bytes", "bytes 99 above the committed budget")
+    out = _apply_waivers(
+        _waiver_entry(Waiver("bytes", "above the committed budget",
+                             "CI allocator variance, tracked")), [f])
+    assert out == []
+
+
+def test_waiver_without_reason_is_a_finding():
+    f = Finding("w.entry", "bytes", "bytes 99 above the committed budget")
+    out = _apply_waivers(
+        _waiver_entry(Waiver("bytes", "above the committed budget", "")),
+        [f])
+    assert any(o.check == "waiver" and "no reason" in o.message
+               for o in out), [o.render() for o in out]
+
+
+def test_stale_waiver_is_a_finding():
+    out = _apply_waivers(
+        _waiver_entry(Waiver("dtype", "int64", "was real once")), [])
+    assert any(o.check == "waiver" and "stale waiver" in o.message
+               for o in out), [o.render() for o in out]
+
+
+def test_waiver_never_crosses_checks():
+    f = Finding("w.entry", "bytes", "int64 input aval 0")
+    out = _apply_waivers(
+        _waiver_entry(Waiver("dtype", "int64", "dtype-only waiver")), [f])
+    assert f in out  # the bytes finding survives
+    assert any("stale waiver" in o.message for o in out)
+
+
+def test_load_registry_rejects_duplicate_names(tmp_path):
+    p = tmp_path / "dup.py"
+    p.write_text(
+        "from tools.simtrace.registry import EntryPoint\n"
+        "ENTRIES = [EntryPoint('x', lambda: None),\n"
+        "           EntryPoint('x', lambda: None)]\n", encoding="utf-8")
+    with pytest.raises(ValueError, match="duplicate"):
+        load_registry(str(p))
+
+
+def test_load_registry_requires_entries(tmp_path):
+    p = tmp_path / "empty.py"
+    p.write_text("x = 1\n", encoding="utf-8")
+    with pytest.raises(AttributeError):
+        load_registry(str(p))
+
+
+def test_entry_skipped_when_devices_insufficient():
+    def never_built():
+        raise AssertionError("build must not run on a skipped entry")
+
+    entry = EntryPoint("needs.galaxy", never_built, devices=1 << 20)
+    findings, notes, measured = audit_entry(entry, ALL_CHECKS, {})
+    assert findings == [] and measured is None
+    assert notes and "skipped" in notes[0]
+
+
+def test_run_registry_rejects_unknown_check():
+    with pytest.raises(ValueError, match="unknown checks"):
+        run_registry([], selected=("retrace", "vibes"))
+
+
+# ---------------------------------------------------------------------------
+# the production registry itself (full audit: slow lane; CI runs the CLI)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_production_registry_audits_clean():
+    entries = load_registry("tools.simtrace.entrypoints")
+    findings, notes, _ = run_registry(
+        entries, ALL_CHECKS, B.load().get("entries"))
+    assert findings == [], "\n".join(f.render() for f in findings)

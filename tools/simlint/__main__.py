@@ -26,6 +26,9 @@ def main(argv=None) -> int:
                          "disables the stale-pragma audit)")
     ap.add_argument("--no-stale", action="store_true",
                     help="skip the stale-pragma audit")
+    ap.add_argument("--fix-stale-pragmas", action="store_true",
+                    help="delete pragmas the stale audit flags (writes the "
+                         "files in place), then re-run the analysis")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -46,6 +49,11 @@ def main(argv=None) -> int:
     total = 0
     for target in args.targets:
         try:
+            if args.fix_stale_pragmas:
+                from tools.simlint.fix import fix_stale
+                for path, line in fix_stale(target, rules=rules):
+                    print(f"{path}:{line} removed stale pragma",
+                          file=sys.stderr)
             found = run(target, rules=rules, stale_check=not args.no_stale)
         except FileNotFoundError as e:
             print(str(e), file=sys.stderr)

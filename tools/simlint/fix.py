@@ -1,0 +1,60 @@
+"""The stale-pragma remover (``--fix-stale-pragmas``).
+
+A stale pragma is already a finding (``pragma-stale``: it suppressed
+nothing in a full-rules run); this gives it a remover instead of leaving
+the deletion to hand-editing. Comment-only pragma lines are deleted
+whole; trailing pragmas are stripped back to the code they annotate.
+Only lines the stale audit actually flagged are touched — a pragma that
+suppressed at least one finding is load-bearing and never rewritten.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from tools.simlint.findings import _PRAGMA_RE
+from tools.simlint.runner import run
+
+
+def strip_stale_lines(source: str, lines) -> tuple[str, int]:
+    """Remove the pragmas at 1-based ``lines`` from ``source``. Returns
+    (new source, pragmas removed). Lines without a parseable pragma are
+    left untouched (the audit and this fixer share _PRAGMA_RE, so a miss
+    means the file changed under us — do nothing rather than guess)."""
+    out = source.splitlines(keepends=True)
+    removed = 0
+    for ln in sorted(set(lines), reverse=True):
+        if not 1 <= ln <= len(out):
+            continue
+        text = out[ln - 1]
+        m = _PRAGMA_RE.search(text)
+        if m is None:
+            continue
+        if text[: m.start()].strip() == "":
+            del out[ln - 1]  # comment-only pragma: drop the whole line
+        else:
+            nl = "\n" if text.endswith("\n") else ""
+            out[ln - 1] = text[: m.start()].rstrip() + nl
+        removed += 1
+    return "".join(out), removed
+
+
+def fix_stale(target: str, rules=None) -> list[tuple[str, int]]:
+    """Run the analyzer over ``target`` and delete every pragma the stale
+    audit flags. Returns the (path, line) pairs removed, already applied
+    to disk."""
+    stale = [f for f in run(target, rules=rules, stale_check=True)
+             if f.rule == "pragma-stale"]
+    by_path = collections.defaultdict(list)
+    for f in stale:
+        by_path[f.path].append(f.line)
+    removed = []
+    for path, lines in sorted(by_path.items()):
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        new, n = strip_stale_lines(src, lines)
+        if n:
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(new)
+            removed.extend((path, ln) for ln in sorted(lines)[:n])
+    return removed

@@ -1,0 +1,30 @@
+"""simtrace — the jaxpr/compiled-program auditor (LINTING.md §12).
+
+simlint (tools/simlint) polices what the *source* says; simtrace polices
+what the *compiled programs* do. A declarative entry-point registry
+(tools/simtrace/entrypoints.py) names every jitted driver surface the perf
+ladder rests on, and five checks audit each entry at the jaxpr /
+lowered-executable level:
+
+- ``retrace``    — trace twice at shape-equivalent, value-distinct inputs;
+                   the jit cache must not grow (one compile per driver).
+- ``donation``   — every declared donated argument must survive into the
+                   executable's input/output buffer aliasing (XLA only
+                   warns to stderr when it silently drops a donation).
+- ``dtype``      — no 64-bit leaks in the jaxpr (traced under x64 so
+                   sloppy promotions surface), and compact-plan state
+                   leaves keep their audited widths end-to-end.
+- ``collective`` — every collective eqn must trace to
+                   ``parallel/exchange.py`` frames (closes the
+                   dynamic-dispatch hole in simlint family 7).
+- ``bytes``      — each entry's argument+output buffer-boundary bytes
+                   (the ``cost_probe`` instrument, reused) must stay
+                   inside the committed budgets in
+                   ``tools/simtrace/budgets.json``.
+
+CLI: ``python -m tools.simtrace`` (exit 0 clean / 1 findings / 2 usage).
+"""
+
+from tools.simtrace.registry import Built, EntryPoint, Finding, Waiver
+
+__all__ = ["Built", "EntryPoint", "Finding", "Waiver"]

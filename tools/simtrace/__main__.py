@@ -1,0 +1,136 @@
+"""CLI: ``python -m tools.simtrace`` (exit 0 clean / 1 findings / 2 usage).
+
+Environment is pinned BEFORE anything imports jax (the tests/conftest.py
+move): CPU backend, 2 virtual devices — so the sharded entry's shapes and
+the committed budgets are deterministic regardless of the invoking shell.
+``--check-budget-hash`` short-circuits before the pin and never imports
+jax, so CI can gate hand-edited budgets in milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _parser():
+    p = argparse.ArgumentParser(
+        prog="python -m tools.simtrace",
+        description="audit the registered jitted entry points at the "
+                    "jaxpr/compiled-program level (LINTING.md §12)")
+    p.add_argument("--registry", default="tools.simtrace.entrypoints",
+                   help="registry module defining ENTRIES (fixture "
+                        "registries under tests/fixtures/simtrace use this)")
+    p.add_argument("--entries", nargs="*", default=None,
+                   help="audit only these entry names")
+    p.add_argument("--checks", nargs="*", default=None,
+                   help="run only these checks "
+                        "(retrace donation dtype collective bytes)")
+    p.add_argument("--budgets", default=None,
+                   help="budgets.json path (default: tools/simtrace/)")
+    p.add_argument("--update-budgets", action="store_true",
+                   help="measure every entry and rewrite budgets.json "
+                        "with provenance + hash")
+    p.add_argument("--list-entries", action="store_true")
+    p.add_argument("--check-budget-hash", action="store_true",
+                   help="verify budgets.json matches its committed sha256 "
+                        "(pure stdlib, no jax import)")
+    return p
+
+
+def main(argv=None) -> int:
+    try:
+        args = _parser().parse_args(argv)
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 2
+
+    from tools.simtrace import budgets as B
+    if args.check_budget_hash:
+        errors = B.verify_hash(args.budgets)
+        for e in errors:
+            print(f"simtrace: {e}")
+        if not errors:
+            print("simtrace: budgets hash ok")
+        return 1 if errors else 0
+
+    # pin the audit environment before any jax-touching import
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
+
+    from tools.simtrace.registry import load_registry
+    from tools.simtrace.runner import ALL_CHECKS, run_registry
+    try:
+        entries = load_registry(args.registry)
+    except Exception as e:
+        print(f"simtrace: cannot load registry {args.registry}: {e}")
+        return 2
+
+    if args.list_entries:
+        for e in entries:
+            print(f"{e.name:24s} {e.description}")
+        return 0
+
+    if args.entries:
+        known = {e.name for e in entries}
+        bad = [n for n in args.entries if n not in known]
+        if bad:
+            print(f"simtrace: unknown entries {bad} "
+                  f"(known: {sorted(known)})")
+            return 2
+        entries = [e for e in entries if e.name in args.entries]
+
+    selected = tuple(args.checks or ALL_CHECKS)
+    if args.update_budgets and "bytes" not in selected:
+        selected = selected + ("bytes",)
+    try:
+        findings, notes, measurements = run_registry(
+            entries, selected,
+            budget_entries=None if args.update_budgets
+            else _budget_entries(B, args.budgets, selected),
+            measure_only=args.update_budgets)
+    except ValueError as e:
+        print(f"simtrace: {e}")
+        return 2
+
+    for n in notes:
+        print(f"simtrace: note: {n}")
+
+    if args.update_budgets:
+        import jax
+        payload = {
+            "provenance": {
+                "backend": jax.default_backend(),
+                "devices": jax.device_count(),
+                "jax": jax.__version__,
+                "registry": args.registry,
+            },
+            "entries": measurements,
+        }
+        path = B.save(payload, args.budgets)
+        print(f"simtrace: wrote {len(measurements)} budgets to {path}")
+
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"simtrace: {len(findings)} finding(s)")
+        return 1
+    print(f"simtrace: {len(entries)} entries clean")
+    return 0
+
+
+def _budget_entries(B, path, selected):
+    if "bytes" not in selected:
+        return {}
+    try:
+        return B.load(path).get("entries", {})
+    except FileNotFoundError:
+        return {}  # per-entry "no committed budget" findings name the fix
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""The committed byte budgets and their provenance hash.
+
+``budgets.json`` is the record of what the registered entries cost at the
+quick shape: per-entry argument/output buffer-boundary bytes plus a
+provenance block (backend, device count, jax version, tolerance) and a
+sha256 over the canonical JSON of both. The hash makes hand-edits
+detectable — CI re-derives it with ``--check-budget-hash`` (pure stdlib,
+no jax import) so a budget loosened in a diff without re-earning it via
+``--update-budgets`` fails before anything compiles.
+
+Deliberately no timestamps: regeneration at the same shape on the same
+stack must be a no-op diff.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+
+DEFAULT_PATH = pathlib.Path(__file__).resolve().parent / "budgets.json"
+
+
+def canonical(payload: dict) -> str:
+    """The canonical JSON the hash is computed over (sorted keys, no
+    whitespace drift) — everything except the hash itself."""
+    body = {k: v for k, v in payload.items() if k != "sha256"}
+    return json.dumps(body, sort_keys=True, separators=(",", ":"))
+
+
+def digest(payload: dict) -> str:
+    return hashlib.sha256(canonical(payload).encode("utf-8")).hexdigest()
+
+
+def load(path=None) -> dict:
+    p = pathlib.Path(path) if path else DEFAULT_PATH
+    with open(p, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def save(payload: dict, path=None) -> pathlib.Path:
+    p = pathlib.Path(path) if path else DEFAULT_PATH
+    payload = dict(payload)
+    payload["sha256"] = digest(payload)
+    with open(p, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return p
+
+
+def verify_hash(path=None) -> list[str]:
+    """Errors (empty when clean). Pure stdlib so CI can gate on it before
+    any jax-touching import."""
+    p = pathlib.Path(path) if path else DEFAULT_PATH
+    if not p.exists():
+        return [f"{p} missing — run python -m tools.simtrace "
+                "--update-budgets and commit it"]
+    try:
+        payload = load(p)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{p} unreadable: {e}"]
+    want = payload.get("sha256", "")
+    got = digest(payload)
+    if want != got:
+        return [f"{p} hash mismatch (committed {want[:12]}.., derived "
+                f"{got[:12]}..) — budgets were hand-edited; re-earn them "
+                "with --update-budgets"]
+    if not payload.get("entries"):
+        return [f"{p} has no entries"]
+    return []

@@ -1,0 +1,437 @@
+"""The five audits. Each takes an ``EntryPoint`` and returns findings.
+
+Every check builds the entry FRESH (``entry.build()``) so the probes are
+independent: the retrace audit owns its jit cache, the dtype audit traces
+under x64 without poisoning anyone else's cache, and the donation/bytes
+audits share one lower+compile.
+"""
+
+from __future__ import annotations
+
+import re
+import warnings
+
+import numpy as np
+
+from tools.simtrace.registry import Built, EntryPoint, Finding
+
+# collective primitives (jaxpr eqn names) the collective audit attributes
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmin", "pmax", "all_gather", "all_to_all", "ppermute",
+    "pbroadcast", "reduce_scatter", "psum_scatter", "pgather",
+    "axis_index",
+})
+# the sanctioned modules: the only frames a collective may trace to
+# (parallel/exchange.py's Exchange implementations and the multi-controller
+# bring-up in parallel/multihost.py)
+SANCTIONED_SUFFIXES = ("parallel/exchange.py", "parallel/multihost.py")
+
+
+# ---------------------------------------------------------------------------
+# shared jaxpr plumbing
+# ---------------------------------------------------------------------------
+
+def iter_eqns(jaxpr):
+    """Depth-first over every eqn including sub-jaxprs (pjit bodies, scan
+    carries, cond branches, while cond/body, custom_* call jaxprs)."""
+    from jax._src.core import ClosedJaxpr, Jaxpr
+
+    def sub(v):
+        if isinstance(v, ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for u in v:
+                yield from sub(u)
+
+    stack = [jaxpr]
+    while stack:
+        jx = stack.pop()
+        for eqn in jx.eqns:
+            yield eqn
+            for val in eqn.params.values():
+                stack.extend(sub(val))
+
+
+def user_frames(eqn):
+    """The eqn's user-code frames (project files, jax internals elided).
+    Empty when the trace carried no source info."""
+    try:
+        from jax._src import source_info_util as siu
+        return list(siu.user_frames(eqn.source_info))
+    except Exception:
+        return []
+
+
+def _frame_str(frames) -> str:
+    if not frames:
+        return "<no source info>"
+    f = frames[0]
+    return f"{f.file_name}:{f.start_line}"
+
+
+def _flat_leaf_ranges(args, static_argnums):
+    """[(argnum, start, stop)] flat-leaf index ranges per non-static arg,
+    in jit's flattening order — the mapping from top-level argnums to the
+    lowered computation's flat parameter positions."""
+    import jax
+
+    ranges, off = [], 0
+    for i, a in enumerate(args):
+        if i in static_argnums:
+            continue
+        n = len(jax.tree.leaves(a))
+        ranges.append((i, off, off + n))
+        off += n
+    return ranges
+
+
+def _leaf_paths(tree):
+    import jax
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [jax.tree_util.keystr(kp) for kp, _ in flat]
+
+
+# ---------------------------------------------------------------------------
+# 1. retrace audit
+# ---------------------------------------------------------------------------
+
+def check_retrace(entry: EntryPoint, built: Built) -> list[Finding]:
+    """Call the entry twice at shape-equivalent, value-distinct inputs and
+    fail if the jit cache grew — a Python-value-dependent trace path
+    (values baked into shapes, static args, or host branches) compiles per
+    value and quietly multiplies the one-compile-per-driver budget."""
+    import jax
+
+    out = built.fn(*built.fresh_args(0))
+    jax.block_until_ready(out)
+    out = built.fn(*built.fresh_args(1))
+    jax.block_until_ready(out)
+    probe = built.cache_size or getattr(built.fn, "_cache_size", None)
+    if probe is None:
+        # fail loudly, never silently pass (the tournament gate's rule):
+        # a renamed probe would otherwise let every retrace regress unseen
+        return [Finding(entry.name, "retrace",
+                        "jit cache probe unavailable (jax renamed "
+                        "_cache_size?) — update tools/simtrace/checks.py")]
+    size = probe()
+    if size is None:
+        return [Finding(entry.name, "retrace",
+                        "jit cache probe returned None — update "
+                        "tools/simtrace/checks.py")]
+    if int(size) != 1:
+        return [Finding(
+            entry.name, "retrace",
+            f"jit cache holds {int(size)} executables after two "
+            "shape-equivalent calls — a value-dependent trace path "
+            "(expected exactly 1 compile)")]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# 2. donation audit
+# ---------------------------------------------------------------------------
+
+_ALIAS_PAIR_RE = re.compile(r"\}:\s*\((\d+)")
+
+
+def _aliased_params(hlo_text: str) -> set[int]:
+    """Parameter numbers that appear in the compiled module's
+    input_output_alias map. The map nests braces (``{ {1}: (0, {},
+    may-alias) }`` — empty output index for a single-array output), so the
+    segment is cut by brace counting, not regex."""
+    start = hlo_text.find("input_output_alias=")
+    if start < 0:
+        return set()
+    j = hlo_text.find("{", start)
+    depth, k = 0, j
+    while k < len(hlo_text):
+        if hlo_text[k] == "{":
+            depth += 1
+        elif hlo_text[k] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        k += 1
+    return {int(p) for p in _ALIAS_PAIR_RE.findall(hlo_text[j:k + 1])}
+
+
+def check_donation(entry: EntryPoint, built: Built) -> list[Finding]:
+    """Every declared donated argument must survive to the executable's
+    input/output aliasing. Catches both failure modes: the jit losing its
+    ``donate_argnums`` (args_info says not donated) and XLA silently
+    dropping a requested donation (aliasing absent — today that is one
+    stderr warning nobody reads)."""
+    import jax
+
+    if not built.donated:
+        return []
+    args = built.fresh_args(0)
+    findings: list[Finding] = []
+    with warnings.catch_warnings(record=True) as wlog:
+        warnings.simplefilter("always")
+        lowered = built.fn.lower(*args)
+        compiled = lowered.compile()
+    for w in wlog:
+        msg = str(w.message)
+        if "donated" in msg.lower():
+            findings.append(Finding(
+                entry.name, "donation",
+                f"lowering warned: {msg.splitlines()[0]}"))
+
+    # declared argnums -> flat leaf ranges -> args_info donated flags
+    info_leaves = jax.tree.leaves(
+        lowered.args_info, is_leaf=lambda x: hasattr(x, "donated"))
+    ranges = _flat_leaf_ranges(args, set(built.static_argnums))
+    by_argnum = {argnum: (lo, hi) for argnum, lo, hi in ranges}
+    for argnum in built.donated:
+        if argnum not in by_argnum:
+            findings.append(Finding(
+                entry.name, "donation",
+                f"declared donated argnum {argnum} is static or missing"))
+            continue
+        lo, hi = by_argnum[argnum]
+        not_flagged = [i for i in range(lo, hi)
+                       if not info_leaves[i].donated]
+        if not_flagged:
+            paths = _leaf_paths(args[argnum])
+            named = [paths[i - lo] for i in not_flagged[:4]]
+            findings.append(Finding(
+                entry.name, "donation",
+                f"arg {argnum}: {len(not_flagged)} leaves were never "
+                f"requested for donation (donate_argnums dropped?): "
+                f"{named}"))
+
+    # requested donations must appear in the compiled aliasing
+    try:
+        kept = sorted(compiled._executable._kept_var_idx)
+        hlo = compiled.as_text()
+    except Exception as e:  # pragma: no cover - jax internals moved
+        findings.append(Finding(
+            entry.name, "donation",
+            f"cannot introspect compiled aliasing ({type(e).__name__}: "
+            f"{e}) — update tools/simtrace/checks.py"))
+        return findings
+    param_of = {flat: rank for rank, flat in enumerate(kept)}
+    aliased = _aliased_params(hlo)
+    for argnum in built.donated:
+        if argnum not in by_argnum:
+            continue
+        lo, hi = by_argnum[argnum]
+        paths = _leaf_paths(args[argnum])
+        missed = []
+        for i in range(lo, hi):
+            if not info_leaves[i].donated:
+                continue  # already reported above
+            if i not in param_of:
+                continue  # pruned as unused — nothing to alias
+            if param_of[i] not in aliased:
+                missed.append(paths[i - lo])
+        if missed:
+            findings.append(Finding(
+                entry.name, "donation",
+                f"arg {argnum}: {len(missed)} donated leaves are NOT "
+                f"aliased in the executable (XLA dropped the donation): "
+                f"{missed[:4]}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 3. dtype audit
+# ---------------------------------------------------------------------------
+
+def _dtype_name(aval) -> str:
+    d = getattr(aval, "dtype", None)
+    if d is None:
+        return ""
+    try:
+        return np.dtype(d).name
+    except TypeError:  # extended dtypes (PRNG key<fry> etc.)
+        return str(d)
+
+
+def check_dtype(entry: EntryPoint, built: Built,
+                build_x64=None) -> list[Finding]:
+    """Two obligations. (a) Round-trip: the output state's leaf dtypes must
+    equal the input state's — a compact-plan state that silently widens
+    between entry and exit defeats the audited-width layout end-to-end.
+    (b) 64-bit scan: re-build and re-trace the entry under x64, where weak
+    Python scalars and dtype-less numpy constructors stop being silently
+    truncated to 32 bits and show up as i64/f64 avals in the jaxpr."""
+    import jax
+
+    findings: list[Finding] = []
+    args = built.fresh_args(0)
+
+    if built.pick_state_out is not None:
+        # bind static args concrete — eval_shape abstracts everything, and
+        # a tracer in a static_argnums slot is unhashable
+        static = set(built.static_argnums)
+        dyn_idx = [i for i in range(len(args)) if i not in static]
+
+        def call_dyn(*dyn):
+            full = list(args)
+            for i, v in zip(dyn_idx, dyn):
+                full[i] = v
+            return built.fn(*full)
+
+        out = jax.eval_shape(call_dyn, *[args[i] for i in dyn_idx])
+        in_leaves = jax.tree.leaves(args[built.state_argnum])
+        in_paths = _leaf_paths(args[built.state_argnum])
+        out_leaves = jax.tree.leaves(built.pick_state_out(out))
+        if len(in_leaves) != len(out_leaves):
+            findings.append(Finding(
+                entry.name, "dtype",
+                f"state round-trip leaf count changed "
+                f"({len(in_leaves)} in, {len(out_leaves)} out)"))
+        else:
+            for path, a, b in zip(in_paths, in_leaves, out_leaves):
+                if a.dtype != b.dtype:
+                    findings.append(Finding(
+                        entry.name, "dtype",
+                        f"state leaf {path} widened {a.dtype} -> "
+                        f"{b.dtype} across the entry"))
+
+    # The x64 scan's policy: float64/complex128 are flagged ANYWHERE (a
+    # wide float changes numerics wherever it appears), but int64/uint64
+    # are flagged only where they PERSIST — program inputs, program
+    # outputs, and scan/while results (the carried state). Transient i64
+    # index machinery (argsort's iota, argmax outputs, numpy-semantics sum
+    # accumulation) is jax's own x64 behavior, invisible under the
+    # production x32 canonicalization, and unfixable at call sites that
+    # already ``.astype(jnp.int32)`` — flagging it would bury the real
+    # regressions (a builder losing its explicit dtype, a widened carry).
+    allowed = set(entry.dtypes)
+    wide_float = {d for d in ("float64", "complex128") if d not in allowed}
+    wide_int = {d for d in ("int64", "uint64") if d not in allowed}
+    from jax.experimental import enable_x64
+    try:
+        with enable_x64():
+            b64 = (build_x64 or entry.build)()
+            args64 = b64.fresh_args(0)
+            jaxpr = jax.make_jaxpr(
+                b64.fn, static_argnums=b64.static_argnums)(*args64)
+    except Exception as e:
+        return findings + [Finding(
+            entry.name, "dtype",
+            f"entry fails to trace under x64 — a 64-bit leak breaks the "
+            f"program outright ({type(e).__name__}: {e})")]
+    seen = set()
+
+    def flag(name, where, why):
+        if (name, where) in seen:
+            return
+        seen.add((name, where))
+        findings.append(Finding(entry.name, "dtype",
+                                f"{name} {where} under x64 — {why}"))
+
+    for i, aval in enumerate(jaxpr.in_avals):
+        name = _dtype_name(aval)
+        if name in wide_int or name in wide_float:
+            flag(name, f"input aval {i}",
+                 "an argument builder lost its explicit narrow dtype")
+    for i, aval in enumerate(jaxpr.out_avals):
+        name = _dtype_name(aval)
+        if name in wide_int or name in wide_float:
+            flag(name, f"output aval {i}",
+                 "the program hands back widened storage")
+    for eqn in iter_eqns(jaxpr.jaxpr):
+        persistent = eqn.primitive.name in ("scan", "while")
+        for v in eqn.outvars:
+            name = _dtype_name(getattr(v, "aval", None))
+            if name in wide_float or (persistent and name in wide_int):
+                what = ("carried through "
+                        if persistent else "produced by ")
+                flag(name, f"{what}{eqn.primitive.name} at "
+                     f"{_frame_str(user_frames(eqn))}",
+                     "a weak scalar or dtype-less constructor leaks "
+                     "64-bit values into stored/compute paths")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 4. collective audit
+# ---------------------------------------------------------------------------
+
+def check_collective(entry: EntryPoint, built: Built) -> list[Finding]:
+    """Every collective eqn in the traced program must carry a frame from
+    the sanctioned exchange modules. simlint family 7 (shard-exchange)
+    polices collective *call sites* in the AST; this closes its blind
+    spot — collectives reached through dynamic dispatch, vendored copies
+    of the helpers, or code outside the family's scope dirs."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(
+        built.fn, static_argnums=built.static_argnums)(*built.fresh_args(0))
+    findings, seen = [], set()
+    for eqn in iter_eqns(jaxpr.jaxpr):
+        if eqn.primitive.name not in COLLECTIVE_PRIMS:
+            continue
+        frames = user_frames(eqn)
+        files = [f.file_name.replace("\\", "/") for f in frames]
+        if any(f.endswith(SANCTIONED_SUFFIXES) for f in files):
+            continue
+        key = (eqn.primitive.name, _frame_str(frames))
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            entry.name, "collective",
+            f"collective {eqn.primitive.name} at {_frame_str(frames)} "
+            f"does not trace to {SANCTIONED_SUFFIXES[0]} — route it "
+            "through the sanctioned Exchange helpers"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 5. byte-budget gate
+# ---------------------------------------------------------------------------
+
+def measure_bytes(entry: EntryPoint, built: Built):
+    """The entry's argument+output buffer-boundary bytes — the cost_probe
+    instrument (tools/cost_probe.py) reused verbatim. Returns None when
+    this jax build has no Compiled.memory_analysis (the probe's documented
+    fallback condition)."""
+    compiled = built.fn.lower(*built.fresh_args(0)).compile()
+    try:
+        ma = compiled.memory_analysis()
+        return {"argument_bytes": int(ma.argument_size_in_bytes),
+                "output_bytes": int(ma.output_size_in_bytes),
+                "bytes": int(ma.argument_size_in_bytes
+                             + ma.output_size_in_bytes)}
+    except Exception:  # jax builds without Compiled.memory_analysis
+        return None
+
+
+def check_bytes(entry: EntryPoint, measured, budget_row) -> list[Finding]:
+    """Compare a measurement against the committed budget row inside the
+    entry's tolerance band. Exceeding the band in EITHER direction is a
+    finding: above means an HBM round-trip or state widening came back;
+    below means the budget is stale and should be re-earned with
+    ``--update-budgets`` (a slack budget gates nothing)."""
+    if measured is None:
+        return []  # memory_analysis unavailable — runner records the note
+    if budget_row is None:
+        return [Finding(
+            entry.name, "bytes",
+            f"no committed budget for '{entry.budget}' — run "
+            "python -m tools.simtrace --update-budgets and commit "
+            "tools/simtrace/budgets.json")]
+    want, got = int(budget_row["bytes"]), int(measured["bytes"])
+    tol = entry.tolerance
+    if want <= 0:
+        return [Finding(entry.name, "bytes",
+                        f"committed budget for '{entry.budget}' is "
+                        f"degenerate ({want})")]
+    drift = (got - want) / want
+    if abs(drift) > tol:
+        direction = "above" if drift > 0 else "below"
+        return [Finding(
+            entry.name, "bytes",
+            f"buffer-boundary bytes {got} are {abs(drift) * 100:.1f}% "
+            f"{direction} the committed budget {want} for "
+            f"'{entry.budget}' (band ±{tol * 100:.0f}%) — an HBM "
+            "regression, or a stale budget to regenerate with "
+            "--update-budgets")]
+    return []

@@ -1,0 +1,246 @@
+"""The production registry: every jitted driver surface the perf ladder
+rests on, built at a quick shape (ISSUE 17 / LINTING.md §12).
+
+Entries here mirror the real drivers' construction exactly — donation
+flags, static argnums, K-bucket padding discipline — because the audits
+prove properties of THESE programs, and a registry that builds a
+simplified cousin proves nothing. Quick shapes keep a full audit pass in
+CI seconds; the byte budgets in budgets.json are committed at these
+shapes (provenance in the file).
+
+Shape discipline: ``fresh_args(variant)`` varies VALUES only (stream
+seed, PRNG key). K is padded to the fixed ``KPAD`` bucket across variants
+— the grid-global-K move from tools/tournament.py — so the retrace audit
+sees a value change, never a legitimate shape recompile.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tools.simtrace.registry import Built, EntryPoint
+
+KPAD = 16  # fixed K bucket every variant's TickArrivals pads to
+T = 8  # ticks per audited call
+C = 4  # clusters (divides the CI device counts 2 and 8)
+
+
+def _quick_cfg(**kw):
+    from multi_cluster_simulator_tpu.config import PolicyKind, SimConfig
+    base = dict(policy=PolicyKind.FIFO, parity=True, n_res=2,
+                max_nodes=4, max_virtual_nodes=0, queue_capacity=16,
+                max_running=32, max_arrivals=64, max_ingest_per_tick=8)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _specs(n_clusters=C):
+    from multi_cluster_simulator_tpu.core.spec import uniform_cluster
+    return [uniform_cluster(i, n_nodes=4, cores=24, memory=18_000)
+            for i in range(n_clusters)]
+
+
+def _stream(variant, n_clusters=C):
+    from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+    return uniform_stream(n_clusters, jobs_per_cluster=24,
+                          horizon_ms=T * 1_000, max_cores=12,
+                          max_mem=9_000, max_dur_ms=6_000,
+                          seed=7 + variant)
+
+
+def _pad_k(ta, k=KPAD):
+    """Pad the rows K axis to the fixed audit bucket with invalid rows —
+    variant streams then share one shape no matter their per-tick maxima."""
+    from multi_cluster_simulator_tpu.core import state as st
+    from multi_cluster_simulator_tpu.ops import queues as Q
+    rows, counts = np.asarray(ta.rows), np.asarray(ta.counts)
+    k0 = rows.shape[2]
+    if k0 > k:
+        raise ValueError(f"stream K {k0} exceeds audit bucket {k}")
+    pad = np.broadcast_to(np.asarray(Q._INVALID_ROW),
+                          rows.shape[:2] + (k - k0, rows.shape[3])).copy()
+    return st.TickArrivals(rows=np.concatenate([rows, pad], axis=2),
+                           counts=counts)
+
+
+def _ticks(variant, n_clusters=C, cfg=None):
+    from multi_cluster_simulator_tpu.core.engine import pack_arrivals_by_tick
+    tick_ms = cfg.tick_ms if cfg is not None else 1_000
+    return _pad_k(pack_arrivals_by_tick(_stream(variant, n_clusters), T,
+                                        tick_ms))
+
+
+def _fresh_state(cfg, specs, plan=None):
+    """A private clone of the reset constellation — init_state shares
+    zero-filled buffers across leaves, which a donating entry may not
+    receive twice (the services/serving.py clone rule)."""
+    import jax
+    import jax.numpy as jnp
+    from multi_cluster_simulator_tpu.core.state import init_state
+    return jax.tree.map(jnp.copy, init_state(cfg, specs, plan=plan))
+
+
+# ---------------------------------------------------------------------------
+# builders (one per registered surface)
+# ---------------------------------------------------------------------------
+
+def _build_run():
+    from multi_cluster_simulator_tpu.core.compact import derive_plan
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    cfg, specs = _quick_cfg(), _specs()
+    plan = derive_plan(cfg, specs, _stream(0))
+    eng = Engine(cfg)
+    fn = eng.run_jit(donate=True)
+
+    def fresh(v):
+        return (_fresh_state(cfg, specs, plan), _ticks(v, cfg=cfg), T)
+
+    return Built(fn=fn, fresh_args=fresh, donated=(0,),
+                 static_argnums=(2,), pick_state_out=lambda o: o)
+
+
+def _build_run_io():
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    cfg, specs = _quick_cfg(), _specs()
+    eng = Engine(cfg)
+    fn = eng.run_io_jit(donate=True)
+
+    def fresh(v):
+        ta = _ticks(v, cfg=cfg)
+        return (_fresh_state(cfg, specs), ta.rows, ta.counts)
+
+    return Built(fn=fn, fresh_args=fresh, donated=(0,),
+                 pick_state_out=lambda o: o[0])
+
+
+def _build_run_compressed():
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    cfg, specs = _quick_cfg(), _specs()
+    eng = Engine(cfg)
+    fn = eng.run_compressed_jit(donate=True)
+
+    def fresh(v):
+        return (_fresh_state(cfg, specs), _ticks(v, cfg=cfg), T)
+
+    return Built(fn=fn, fresh_args=fresh, donated=(0,),
+                 static_argnums=(2,), pick_state_out=lambda o: o[0])
+
+
+def _build_step_tick():
+    # the env-mode scan body; donation happens one level up (the env's
+    # batch_step_fn donates the whole EnvState), so none is declared here
+    import jax
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    cfg, specs = _quick_cfg(), _specs()
+    eng = Engine(cfg)
+    fn = jax.jit(eng.step_tick)
+
+    def fresh(v):
+        ta = _ticks(v, cfg=cfg)
+        return (_fresh_state(cfg, specs), ta.rows[0], ta.counts[0])
+
+    return Built(fn=fn, fresh_args=fresh, pick_state_out=lambda o: o)
+
+
+def _build_sharded():
+    import jax
+    from jax.sharding import Mesh
+    from multi_cluster_simulator_tpu.parallel.sharded_engine import (
+        ShardedEngine,
+    )
+    # borrowing ON: the borrow match and return delivery are the paths
+    # that ride the mesh exchange, and without them the traced program
+    # carries zero collectives — the collective audit would be vacuously
+    # clean and a rogue psum in a dense-path refactor would sail through
+    cfg, specs = _quick_cfg(borrowing=True, max_virtual_nodes=2), _specs()
+    mesh = Mesh(np.array(jax.devices()[:2]), ("clusters",))
+    se = ShardedEngine(cfg, mesh)
+    fn = se.run_fn(n_ticks=T, tick_indexed=True, donate=True)
+
+    def fresh(v):
+        return se.shard_inputs(_fresh_state(cfg, specs), _ticks(v, cfg=cfg))
+
+    return Built(fn=fn, fresh_args=fresh, donated=(0,),
+                 pick_state_out=lambda o: o)
+
+
+def _build_tournament_cell():
+    # the (policy, seed) grid cell from tools/tournament.py: vmap over a
+    # stacked-seed TickArrivals, params as traced data, no donation (the
+    # grid reuses one reset state across cells)
+    import jax
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.policies.base import PolicySet
+    cfg, specs = _quick_cfg(), _specs()
+    pset = PolicySet(("fifo", "delay"))
+    eng = Engine(cfg, policies=pset)
+
+    def grid_fn(state, ta, params):
+        return jax.vmap(lambda a: eng.run(state, a, T, params=params))(ta)
+
+    fn = jax.jit(grid_fn)
+
+    def fresh(v):
+        tas = [_ticks(2 * v + s, cfg=cfg) for s in range(2)]
+        stacked = jax.tree.map(lambda *ls: np.stack(ls), *tas)
+        return (_fresh_state(cfg, specs), stacked,
+                pset.params_for(cfg))
+
+    return Built(fn=fn, fresh_args=fresh, pick_state_out=lambda o: o)
+
+
+def _build_env_step():
+    import jax
+    from multi_cluster_simulator_tpu.envs.cluster_env import ClusterEnv
+    cfg, specs = _quick_cfg(), _specs()
+    env = ClusterEnv(cfg, specs, episode_ticks=T, arrivals=_ticks(0, cfg=cfg))
+    call = env.batch_step_fn(donate=True)
+    fn = call._jit  # (es, action, sim0, arr) — sim0/arr broadcast args
+
+    def fresh(v):
+        _, es = env.reset_batch(jax.random.PRNGKey(100 + v), 3)
+        return (es, None, env._sim0, env._arr)
+
+    return Built(fn=fn, fresh_args=fresh, donated=(0,),
+                 pick_state_out=lambda o: o[4])
+
+
+def _build_serving_dispatch():
+    # the serving tier's coalesced obs-path dispatch (services/serving.py):
+    # run_io with the metrics plane threaded, state donated, the chunk's
+    # rows packed exactly as ServingHost._dispatch packs them
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    from multi_cluster_simulator_tpu.obs.device import metrics_init
+    n = 2
+    cfg, specs = _quick_cfg(), _specs(n)
+    eng = Engine(cfg)
+    fn = eng.run_io_jit(donate=True)
+
+    def fresh(v):
+        state = _fresh_state(cfg, specs)
+        ta = _ticks(v, n, cfg=cfg)
+        return (state, ta.rows[:4], ta.counts[:4], None,
+                metrics_init(state))
+
+    return Built(fn=fn, fresh_args=fresh, donated=(0,),
+                 pick_state_out=lambda o: o[0])
+
+
+ENTRIES = [
+    EntryPoint("engine.run", _build_run,
+               description=f"run_jit(donate) C={C} T={T} K<={KPAD} compact"),
+    EntryPoint("engine.run_io", _build_run_io,
+               description=f"run_io_jit(donate) C={C} T={T} K<={KPAD}"),
+    EntryPoint("engine.run_compressed", _build_run_compressed,
+               description=f"run_compressed_jit(donate) C={C} T={T}"),
+    EntryPoint("engine.step_tick", _build_step_tick,
+               description=f"jit(step_tick) C={C} K<={KPAD}"),
+    EntryPoint("sharded.run_fn", _build_sharded, devices=2,
+               description=f"shard_map run_fn(donate) C={C} T={T} mesh=2"),
+    EntryPoint("tournament.cell", _build_tournament_cell,
+               description=f"vmap-seed grid cell C={C} T={T} policies=2"),
+    EntryPoint("env.step", _build_env_step,
+               description=f"batch_step_fn(donate) C={C} B=3 ep={T}"),
+    EntryPoint("serving.dispatch", _build_serving_dispatch,
+               description="run_io_jit(donate)+metrics C=2 T=4"),
+]

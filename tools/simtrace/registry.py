@@ -1,0 +1,108 @@
+"""The declarative model: entry points, builds, waivers, findings.
+
+An ``EntryPoint`` names one jitted driver surface and how to build it at a
+quick shape; the checks (tools/simtrace/checks.py) consume the ``Built``
+it produces. Fixture registries (tests/fixtures/simtrace/) define the same
+``ENTRIES`` attribute over deliberately broken mini-drivers — the CLI's
+``--registry`` flag points the auditor at them, which is how every check
+gets a good/bad fixture pair without a second harness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import importlib.util
+import pathlib
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One ``entry check message`` diagnostic."""
+
+    entry: str
+    check: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.entry} {self.check} {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Waiver:
+    """Entry-level suppression, declared in the registry next to the entry
+    it covers (simtrace's analogue of the simlint pragma — the policy is
+    the same: a waiver without a reason is a finding, and a waiver that
+    suppresses nothing is stale and reported)."""
+
+    check: str  # which check's findings this covers
+    match: str  # substring matched against the finding message
+    reason: str  # mandatory justification
+
+
+@dataclasses.dataclass
+class Built:
+    """One materialized entry: the jitted callable plus everything the
+    checks need to drive it.
+
+    ``fresh_args(variant)`` must return shape-equivalent but value-distinct
+    arguments for distinct variants, with FRESH buffers each call (donating
+    entries consume them). Shapes must be variant-invariant — hold padding
+    buckets fixed the way the production drivers do (pow2 K buckets,
+    grid-global K), because a shape change is a legitimate compile and the
+    retrace audit must only see value changes."""
+
+    fn: Any  # the jitted callable (has .lower / ._cache_size)
+    fresh_args: Callable[[int], tuple]
+    donated: tuple = ()  # top-level argnums the entry declares donated
+    static_argnums: tuple = ()  # excluded from flat-leaf offset math
+    state_argnum: int = 0  # which input arg is the state pytree
+    # outputs pytree -> the state subtree (dtype round-trip audit); None
+    # skips the round-trip (entries whose outputs carry no state)
+    pick_state_out: Optional[Callable] = None
+    # override for the jit-cache probe (entries that wrap their jit)
+    cache_size: Optional[Callable[[], Optional[int]]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    """One registered driver surface. ``build`` is called fresh per check
+    so checks cannot contaminate each other's jit caches."""
+
+    name: str
+    build: Callable[[], Built]
+    description: str = ""
+    budget_key: str = ""  # budgets.json key (defaults to ``name``)
+    devices: int = 1  # minimum device count; fewer -> entry is skipped
+    tolerance: float = 0.05  # byte-budget relative band
+    # dtype names allowed past the 64-bit scan (beyond the always-allowed
+    # narrow set) — each needs a waiver-grade justification in the registry
+    dtypes: tuple = ()
+    waivers: tuple = ()
+
+    @property
+    def budget(self) -> str:
+        return self.budget_key or self.name
+
+
+def load_registry(module_name: str):
+    """Import a registry module and return its ``ENTRIES`` list. Accepts a
+    dotted module name or a ``.py`` path (fixture registries). Raises
+    ``AttributeError`` (not a silent empty audit) when the module forgot
+    to define one."""
+    if module_name.endswith(".py"):
+        p = pathlib.Path(module_name)
+        spec = importlib.util.spec_from_file_location(
+            f"simtrace_registry_{p.stem}", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(module_name)
+    entries = getattr(mod, "ENTRIES")
+    names = [e.name for e in entries]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(f"registry {module_name} has duplicate entry "
+                         f"names: {sorted(dupes)}")
+    return list(entries)

@@ -1,0 +1,96 @@
+"""Orchestration: run the selected checks over a registry's entries,
+apply waivers, and settle the byte budgets.
+
+Each check group rebuilds the entry fresh (``entry.build()``) so probes
+stay independent — the retrace audit owns its jit cache and the dtype
+audit's x64 trace cannot pollute the donation/bytes lower+compile.
+"""
+
+from __future__ import annotations
+
+from tools.simtrace import checks as C
+from tools.simtrace.registry import EntryPoint, Finding
+
+ALL_CHECKS = ("retrace", "donation", "dtype", "collective", "bytes")
+
+
+def _apply_waivers(entry: EntryPoint, findings):
+    """Waiver policy (the simlint pragma policy, verbatim): a waiver needs
+    a reason, and a waiver that suppresses nothing is itself stale."""
+    out, used = [], [False] * len(entry.waivers)
+    for f in findings:
+        waived = False
+        for i, w in enumerate(entry.waivers):
+            if w.check == f.check and w.match in f.message:
+                used[i] = True
+                if not w.reason.strip():
+                    out.append(Finding(
+                        entry.name, "waiver",
+                        f"waiver for {w.check}/'{w.match}' has no reason"))
+                else:
+                    waived = True
+        if not waived:
+            out.append(f)
+    for i, w in enumerate(entry.waivers):
+        if not used[i]:
+            out.append(Finding(
+                entry.name, "waiver",
+                f"stale waiver: no {w.check} finding matches "
+                f"'{w.match}' — delete it"))
+    return out
+
+
+def audit_entry(entry: EntryPoint, selected, budget_entries,
+                measure_only=False):
+    """Run ``selected`` checks for one entry. Returns
+    ``(findings, notes, measurement)`` — measurement is the bytes dict
+    (or None) so ``--update-budgets`` reuses the same pass."""
+    import jax
+
+    notes, raw, measured = [], [], None
+    if jax.device_count() < entry.devices:
+        notes.append(f"{entry.name}: skipped (needs {entry.devices} "
+                     f"devices, have {jax.device_count()})")
+        return [], notes, None
+
+    if "retrace" in selected:
+        raw += C.check_retrace(entry, entry.build())
+    if "donation" in selected:
+        raw += C.check_donation(entry, entry.build())
+    if "dtype" in selected:
+        raw += C.check_dtype(entry, entry.build())
+    if "collective" in selected:
+        raw += C.check_collective(entry, entry.build())
+    if "bytes" in selected:
+        measured = C.measure_bytes(entry, entry.build())
+        if measured is None:
+            notes.append(f"{entry.name}: memory_analysis unavailable on "
+                         "this jax build — bytes gate skipped")
+        elif not measure_only:
+            row = (budget_entries or {}).get(entry.budget)
+            raw += C.check_bytes(entry, measured, row)
+    return _apply_waivers(entry, raw), notes, measured
+
+
+def run_registry(entries, selected=None, budget_entries=None,
+                 measure_only=False):
+    """Audit every entry. Returns ``(findings, notes, measurements)``
+    where measurements maps budget key -> bytes dict for entries that were
+    measured. ``measure_only`` skips the budget comparison but still
+    measures (the ``--update-budgets`` pass)."""
+    selected = tuple(selected or ALL_CHECKS)
+    unknown = [c for c in selected if c not in ALL_CHECKS]
+    if unknown:
+        raise ValueError(f"unknown checks: {unknown} "
+                         f"(valid: {list(ALL_CHECKS)})")
+    findings, notes, measurements = [], [], {}
+    for entry in entries:
+        f, n, m = audit_entry(entry, selected, budget_entries,
+                              measure_only=measure_only)
+        findings += f
+        notes += n
+        if m is not None:
+            measurements[entry.budget] = dict(
+                m, devices=entry.devices,
+                shape=entry.description or "quick")
+    return findings, notes, measurements
