@@ -95,7 +95,9 @@ class Snapshot:
     __slots__ = ("wall", "sim_t", "stage_t", "placed_total", "placed",
                  "jobs_in_queue", "queue_depth", "running", "avg_wait_ms",
                  "drops", "queue_ids", "run_ids", "run_active",
-                 "dispatches", "staged_jobs")
+                 "dispatches", "staged_jobs", "tenants", "depth_tc",
+                 "placed_t", "running_tc", "jobs_in_queue_tc",
+                 "avg_wait_tc")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -104,16 +106,22 @@ class Snapshot:
     def age_ms(self) -> float:
         return (time.time() - self.wall) * 1000.0
 
-    def job_status(self, cluster: int, jid: int) -> str:
+    def job_status(self, cluster: int, jid: int, tenant: int = 0) -> str:
         """queued | running | unknown — a placement lookup over the
         snapshot's id columns (host numpy, no device access). ``unknown``
         covers both never-seen and already-completed ids; the submit log
-        (when latency tracking is on) disambiguates bench-side."""
+        (when latency tracking is on) disambiguates bench-side. Tenant-
+        stacked snapshots (hosting T > 1) index the id columns by the
+        tenant's row first."""
         for ids in self.queue_ids:
-            if (ids[cluster] == jid).any():
+            col = ids[tenant] if ids.ndim == 3 else ids
+            if (col[cluster] == jid).any():
                 return "queued"
-        hit = self.run_ids[cluster] == jid
-        if (hit & self.run_active[cluster]).any():
+        rid = self.run_ids[tenant] if self.run_ids.ndim == 3 else self.run_ids
+        act = (self.run_active[tenant] if self.run_active.ndim == 3
+               else self.run_active)
+        hit = rid[cluster] == jid
+        if (hit & act[cluster]).any():
             return "running"
         return "unknown"
 
@@ -153,7 +161,9 @@ class ServingScheduler(Service):
                  checkpoint_every: int = 8, recover: bool = True,
                  wal_rotate_bytes: int = 64 << 20,
                  pricing_budget_ms: Optional[float] = None,
-                 pricing_reprobe: int = 64, **kw):
+                 pricing_reprobe: int = 64, tenants: int = 1,
+                 tenant_params=None, adaptive_window: bool = False,
+                 adaptive_deadline_ms: Optional[float] = None, **kw):
         """Crash recovery (services/wal.py, ARCHITECTURE.md §fault plane):
         ``wal_path`` arms the staged-arrival write-ahead log — every
         accepted submit is fsync'd to it BEFORE the 200-ack, so an acked
@@ -177,8 +187,36 @@ class ServingScheduler(Service):
         self.window = int(window)
         self.k_cap = int(k_cap)
         self.C = len(self.specs)
+        # multi-tenant hosting (tenancy/, ROADMAP item 3): T independent
+        # constellations resident as ONE tenant-stacked SimState, advanced
+        # by the tenant-batched run_io — per-tenant routing, staging
+        # buckets, quotas and stats ride a tenant index through the same
+        # stage->seal->coalesce->dispatch pipeline. T == 1 is byte-for-byte
+        # the classic single-tenant front door.
+        self.T = int(tenants)
+        if self.T < 1:
+            raise ValueError(f"tenants must be >= 1, got {tenants}")
+        if self.T > 1:
+            if wal_path is not None or checkpoint_path is not None:
+                raise ValueError(
+                    "multi-tenant serving does not arm WAL/checkpoint "
+                    "durability — run one tenant per durable service")
+            if pricing_budget_ms is not None:
+                raise ValueError(
+                    "pricing_budget_ms is single-tenant: the budget clock "
+                    "times one constellation's trade rounds")
+        # adaptive coalesce windows (tail latency, ROADMAP item 3): seal
+        # the open tick early when a bucket fills, and let the drive loop
+        # dispatch a PARTIAL window once the oldest sealed tick has waited
+        # past the deadline — light traffic pays the deadline, not the
+        # full fixed window wall. Placement is untouched (dispatch is the
+        # same run_io scan, PARITY.md §serving); only pacing changes.
+        self.adaptive_window = bool(adaptive_window)
+        self.adaptive_deadline_ms = (float(adaptive_deadline_ms)
+                                     if adaptive_deadline_ms is not None
+                                     else None)
         self.max_staged = (int(max_staged) if max_staged is not None
-                           else 4 * self.window * self.C)
+                           else 4 * self.window * self.C * self.T)
         self.pacer = pacer
         self.snapshot_every = max(int(snapshot_every), 1)
         self.track_latency = track_latency
@@ -242,35 +280,76 @@ class ServingScheduler(Service):
         # every buffer is unique — init_state shares zero-filled buffers
         # across leaves, which a donating dispatch may not receive twice
         import jax.numpy as jnp
-        self._state = jax.tree.map(jnp.copy, init_state(cfg, self.specs))
+        if self.T > 1:
+            from multi_cluster_simulator_tpu import tenancy
+            self._tenancy = tenancy
+            self._batch = tenancy.TenantBatch(cfg, self.specs)
+            tp = (tenant_params if tenant_params is not None
+                  else self._batch.default_params(self.T))
+            if tenancy.n_tenants(tp) != self.T:
+                raise ValueError(
+                    f"tenant_params holds {tenancy.n_tenants(tp)} tenants, "
+                    f"service hosts {self.T}")
+            self._tp = tp
+            # per-tenant admission quota (the quota_jobs leaf): a bound on
+            # the tenant's staged+queued backlog; -1 = unmetered
+            self._quota = np.asarray(tp.quota_jobs).astype(np.int64)
+            self._state = self._batch.init_stacked(tp)
+        else:
+            self._tenancy = None
+            self._tp = None
+            self._quota = None
+            self._state = jax.tree.map(jnp.copy, init_state(cfg, self.specs))
         # the device metrics plane: one MetricsBuffer rides every run_io
         # dispatch (same single owner as the state — the drive thread) and
         # is harvested at the snapshot refresh, the sync point the loop
         # already pays; its gauges bridge into self.meter so /metrics and
-        # the OTLP export report identical numbers
-        self._mbuf = (obs_device.metrics_init(self._state) if self.obs
-                      else None)
+        # the OTLP export report identical numbers. Multi-tenant hosting
+        # stacks one buffer per tenant — the tenant row of the harvest.
+        if not self.obs:
+            self._mbuf = None
+        elif self.T > 1:
+            mb0 = obs_device.metrics_init(
+                self._tenancy.tenant_cell(self._state, 0))
+            self._mbuf = jax.tree.map(
+                lambda leaf: jnp.stack([leaf] * self.T), mb0)
+        else:
+            self._mbuf = obs_device.metrics_init(self._state)
         self._obs_harvest: dict = {}
-        self._run_io = self.engine.run_io_jit(donate=True)
+        self._run_io = (self._batch.run_io_fn(donate=True, obs=self.obs)
+                        if self.T > 1
+                        else self.engine.run_io_jit(donate=True))
         self._delay_policy = cfg.policy is not PolicyKind.FIFO
         # staging: one open bucket per cluster for the current tick, a
         # FIFO of sealed per-tick buckets awaiting dispatch, and the
         # parked mismatched-endpoint jobs (applied at dispatch time)
-        self._stage_lock = threading.Lock()  # guards: _open, _sealed, _stage_t, _staged_jobs, _parked, _rejected, _submit_wall, _unseen
-        self._open: list[list[tuple]] = [[] for _ in range(self.C)]
-        self._sealed: list[list[list[tuple]]] = []
+        self._stage_lock = threading.Lock()  # guards: _open, _sealed, _stage_t, _staged_jobs, _parked, _rejected, _rejected_t, _submit_wall, _unseen, _sealed_walls
+        # staging buckets are per (tenant, cluster): tenant routing is a
+        # staging index, never a device concern (the dispatch stacks the
+        # buckets into the tenant-batched chunk). T == 1 keeps one row.
+        self._open: list[list[list[tuple]]] = [
+            [[] for _ in range(self.C)] for _ in range(self.T)]
+        self._sealed: list[list[list[list[tuple]]]] = []
         self._stage_t = 0  # ticks staged (== index of the open tick)
         self._staged_jobs = 0  # staged, not yet dispatched (back-pressure)
-        # per-cluster jobs admitted but not yet visible in a snapshot's
-        # queue depth (staged OR dispatched-since-last-refresh): the
-        # admission bound snap.queue_depth[c] + _unseen[c] <= queue_capacity
-        # makes a device queue-overflow drop impossible by construction —
-        # saturation surfaces as a 503 quote, never a silent drop
-        self._unseen = np.zeros(self.C, np.int64)
-        self._parked: list[tuple] = []  # (c, row, to_delay)
+        # per-(tenant, cluster) jobs admitted but not yet visible in a
+        # snapshot's queue depth (staged OR dispatched-since-last-refresh):
+        # the admission bound snap.depth_tc[tn, c] + _unseen[tn, c] <=
+        # queue_capacity makes a device queue-overflow drop impossible by
+        # construction — saturation surfaces as a 503 quote, never a
+        # silent drop
+        self._unseen = np.zeros((self.T, self.C), np.int64)
+        self._parked: list[tuple] = []  # (c, row, to_delay) — T == 1 only
         self._rejected = 0
+        self._rejected_t = np.zeros(self.T, np.int64)
         self._submit_wall: dict[tuple, float] = {}
-        self._inflight = np.zeros(self.C, np.int64)  # drive-thread-owned
+        self._inflight = np.zeros((self.T, self.C), np.int64)  # drive-thread-owned
+        # seal/dispatch cadence bookkeeping: per-sealed-tick walls feed the
+        # adaptive deadline (oldest sealed tick's age), inter-dispatch
+        # walls feed the MEASURED staging-latency quote (/quote)
+        import collections as _c
+        self._sealed_walls: _c.deque = _c.deque()
+        self._dispatch_walls: _c.deque = _c.deque(maxlen=33)
         # dispatch bookkeeping (drive/driver thread only — single owner,
         # like the state): ticks dispatched, per-dispatch batch sizes, and
         # the snapshot visibility log the latency accounting reads. A
@@ -330,7 +409,8 @@ class ServingScheduler(Service):
         # one compiled probe for the whole snapshot's scalar/vector reads:
         # the eager per-op form cost more than a full dispatch at serving
         # shapes (each eager op is its own device round trip on CPU)
-        self._snap_probe = jax.jit(self._snap_probe_fn)
+        self._snap_probe = jax.jit(jax.vmap(self._snap_probe_fn)
+                                   if self.T > 1 else self._snap_probe_fn)
         self._refresh_snapshot()
         if wal_path is not None:
             self._open_wal(recover=recover)
@@ -365,13 +445,24 @@ class ServingScheduler(Service):
             d = json.loads(body)
             jid, cores, mem, dur_ms, _ = job_from_json(d)
             c = int(d.get("Cluster", 0))
+            tn = int(d.get("Tenant", 0))
             gpu = int(d.get("GpusNeeded", 0))
         except (ValueError, TypeError):
             return 400, None
         if not (0 <= c < self.C):
             return 400, json.dumps({"Error": f"no cluster {c}"}).encode()
+        if not (0 <= tn < self.T):
+            return 400, json.dumps({"Error": f"no tenant {tn}"}).encode()
+        if self.T > 1 and delay != self._delay_policy:
+            # parked (mismatched-endpoint) jobs are single-tenant Go-wire
+            # parity: under hosting, a job aimed at the queue the policy
+            # never drains is a client bug answered up front
+            return 400, json.dumps(
+                {"Error": "endpoint does not match the hosted policy "
+                          "(multi-tenant hosting has no parked queue)"}
+            ).encode()
         rejected, reasons, accepted, depth = self._stage(
-            [(c, jid, cores, mem, gpu, dur_ms, delay)])
+            [(tn, c, jid, cores, mem, gpu, dur_ms, delay)])
         if rejected:
             return 503, self._quote(rejected, reasons, accepted, depth)
         self.meter.add("jobs_submitted", 1)
@@ -395,13 +486,21 @@ class ServingScheduler(Service):
             jobs = []
             for d in arr:
                 jid, cores, mem, dur_ms, _ = job_from_json(d)
-                jobs.append((int(d.get("Cluster", 0)), jid, cores, mem,
+                jobs.append((int(d.get("Tenant", 0)),
+                             int(d.get("Cluster", 0)), jid, cores, mem,
                              int(d.get("GpusNeeded", 0)), dur_ms,
                              bool(d.get("Delay", self._delay_policy))))
         except (ValueError, TypeError, KeyError):
             return 400, None
-        if any(not (0 <= j[0] < self.C) for j in jobs):
+        if any(not (0 <= j[1] < self.C) for j in jobs):
             return 400, json.dumps({"Error": "bad Cluster"}).encode()
+        if any(not (0 <= j[0] < self.T) for j in jobs):
+            return 400, json.dumps({"Error": "bad Tenant"}).encode()
+        if self.T > 1 and any(j[7] != self._delay_policy for j in jobs):
+            return 400, json.dumps(
+                {"Error": "Delay does not match the hosted policy "
+                          "(multi-tenant hosting has no parked queue)"}
+            ).encode()
         rejected, reasons, accepted, depth = self._stage(jobs)
         self.meter.add("jobs_submitted", accepted)
         # the handler-side jobs_in_queue counter moves for every accepted
@@ -409,7 +508,7 @@ class ServingScheduler(Service):
         # (server.go:75-76) — the two wire paths expose one meter
         rej = set(rejected)
         n_delay = sum(1 for i, j in enumerate(jobs)
-                      if j[6] and i not in rej)
+                      if j[7] and i not in rej)
         if n_delay:
             self.meter.add("jobs_in_queue", n_delay)
         if rejected:
@@ -438,13 +537,33 @@ class ServingScheduler(Service):
         return s, None
 
     def _handle_stats(self, body: bytes, headers: dict):
-        """GET /stats — constellation totals from the latest snapshot
-        (never the device)."""
+        """GET /stats[?tenant=i] — constellation totals from the latest
+        snapshot (never the device); ``tenant`` narrows every figure to
+        one hosted tenant's row."""
+        tn = self._query_int(headers, "tenant", -1)
         s, stale_age = self._fresh_snap()
         if s is None:
             return self._stale_503(stale_age)
+        if tn >= 0:
+            if tn >= self.T:
+                return 400, json.dumps(
+                    {"Error": f"no tenant {tn}"}).encode()
+            with self._stage_lock:
+                rej = int(self._rejected_t[tn])
+                unseen = int(self._unseen[tn].sum())
+            return 200, json.dumps({
+                "tenant": tn, "t_ms": s.sim_t,
+                "stage_t_ticks": s.stage_t,
+                "placed_total": int(s.placed_t[tn]),
+                "running": int(s.running_tc[tn].sum()),
+                "queue_depth": int(s.depth_tc[tn].sum()),
+                "jobs_in_queue": int(s.jobs_in_queue_tc[tn].sum()),
+                "staged_unseen": unseen, "dispatches": s.dispatches,
+                "rejected_503": rej,
+                "snapshot_age_ms": round(s.age_ms(), 3)}).encode()
         return 200, json.dumps({
             "t_ms": s.sim_t, "stage_t_ticks": s.stage_t,
+            "tenants": self.T,
             "placed_total": s.placed, "running": int(s.running.sum()),
             "queue_depth": int(s.queue_depth.sum()),
             "jobs_in_queue": int(s.jobs_in_queue.sum()),
@@ -453,35 +572,45 @@ class ServingScheduler(Service):
             "snapshot_age_ms": round(s.age_ms(), 3)}).encode()
 
     def _handle_quote(self, body: bytes, headers: dict):
-        """GET /quote?cluster=N — wait-time quote for a would-be submitter:
-        the snapshot's average wait plus one coalesce window of staging
-        latency. Pure snapshot arithmetic."""
+        """GET /quote?cluster=N[&tenant=i] — wait-time quote for a
+        would-be submitter: the tenant row's average wait plus the
+        MEASURED staging latency (recent seal-to-dispatch cadence, see
+        ``_measured_window_ms``) — under adaptive windows the fixed
+        window wall over-quotes, sometimes by the whole window. Pure
+        snapshot + host-deque arithmetic."""
         c = self._query_int(headers, "cluster", 0)
+        tn = self._query_int(headers, "tenant", 0)
         if not (0 <= c < self.C):
             return 400, None
+        if not (0 <= tn < self.T):
+            return 400, json.dumps({"Error": f"no tenant {tn}"}).encode()
         s, stale_age = self._fresh_snap()
         if s is None:
             return self._stale_503(stale_age)
+        aw = float(s.avg_wait_tc[tn][c])
         return 200, json.dumps({
-            "cluster": c,
-            "wait_quote_ms": round(float(s.avg_wait_ms[c])
-                                   + self._window_wall_ms(), 3),
-            "avg_wait_ms": round(float(s.avg_wait_ms[c]), 3),
-            "queue_depth": int(s.queue_depth[c]),
+            "cluster": c, "tenant": tn,
+            "wait_quote_ms": round(aw + self._measured_window_ms(), 3),
+            "avg_wait_ms": round(aw, 3),
+            "queue_depth": int(s.depth_tc[tn][c]),
             "snapshot_age_ms": round(s.age_ms(), 3)}).encode()
 
     def _handle_placed(self, body: bytes, headers: dict):
-        """GET /placed?cluster=N&id=J — placement lookup over the snapshot
-        id columns."""
+        """GET /placed?cluster=N&id=J[&tenant=i] — placement lookup over
+        the snapshot id columns."""
         c = self._query_int(headers, "cluster", 0)
         jid = self._query_int(headers, "id", -1)
+        tn = self._query_int(headers, "tenant", 0)
         if not (0 <= c < self.C):
             return 400, None
+        if not (0 <= tn < self.T):
+            return 400, json.dumps({"Error": f"no tenant {tn}"}).encode()
         s, stale_age = self._fresh_snap()
         if s is None:
             return self._stale_503(stale_age)
         return 200, json.dumps({
-            "cluster": c, "id": jid, "status": s.job_status(c, jid),
+            "cluster": c, "id": jid,
+            "status": s.job_status(c, jid, tenant=tn),
             "snapshot_age_ms": round(s.age_ms(), 3)}).encode()
 
     def _handle_quiesce(self, body: bytes, headers: dict):
@@ -552,6 +681,22 @@ class ServingScheduler(Service):
     def _window_wall_ms(self) -> float:
         return self.window * self.cfg.tick_ms / self.speed
 
+    def _measured_window_ms(self) -> float:
+        """The staging latency a quote should promise: the MEAN measured
+        inter-dispatch wall interval over the recent dispatch history,
+        falling back to the configured window wall before two dispatches
+        exist. Under adaptive windows ticks seal when buckets fill or
+        deadlines pass, so the fixed ``_window_wall_ms`` bound can
+        over-quote by nearly a whole window — quoting the measured
+        cadence is the fix tests/test_services.py pins."""
+        walls = list(self._dispatch_walls)
+        if len(walls) < 2:
+            return self._window_wall_ms()
+        span = walls[-1] - walls[0]
+        if span <= 0.0:
+            return self._window_wall_ms()
+        return (span / (len(walls) - 1)) * 1000.0
+
     # ------------------------------------------------------------------
     # staging (the only submit-path work: host tuples under one lock)
     # ------------------------------------------------------------------
@@ -564,25 +709,27 @@ class ServingScheduler(Service):
 
     def _stage(self, jobs: list[tuple], ta: Optional[int] = None,
                live_bounds: bool = True):
-        """Stage (cluster, id, cores, mem, gpu, dur_ms, delay) tuples onto
-        the open tick, admitting per job: a saturated cluster rejects its own
-        jobs without head-of-line-blocking the rest of the batch. Three
-        admission bounds, each surfacing as a quoted 503 (never a silent
-        drop):
+        """Stage (tenant, cluster, id, cores, mem, gpu, dur_ms, delay)
+        tuples onto the open tick, admitting per job: a saturated
+        (tenant, cluster) cell rejects its own jobs without
+        head-of-line-blocking the rest of the batch. Four admission
+        bounds, each surfacing as a quoted 503 (never a silent drop):
 
         - ``max_staged`` — total staging-ring room;
-        - ``queue`` — ``snapshot queue_depth[c] + unseen[c]`` admitted
-          against ``cfg.queue_capacity``, which makes a device queue-
-          overflow drop impossible by construction (every admitted job is
-          counted until a snapshot proves it left the queues);
-        - ``k_cap`` — the per-(tick, cluster) bucket bound (also the
-          compiled K ceiling).
+        - ``quota`` — the tenant's ``quota_jobs`` budget (TenantParams)
+          against its staged+queued backlog, when metered;
+        - ``queue`` — ``snapshot depth_tc[tn, c] + unseen[tn, c]``
+          admitted against ``cfg.queue_capacity``, which makes a device
+          queue-overflow drop impossible by construction (every admitted
+          job is counted until a snapshot proves it left the queues);
+        - ``k_cap`` — the per-(tick, tenant, cluster) bucket bound (also
+          the compiled K ceiling).
 
         ``ta`` overrides the arrival stamp (deterministic drivers feeding
         a trace — it must bucket to the open tick, asserted);
-        ``live_bounds=False`` drops the queue-budget bound for those
-        drivers: they follow a fixed trace the caller has sized, assert
-        zero drops afterwards, and must not have live back-pressure
+        ``live_bounds=False`` drops the queue-budget and quota bounds for
+        those drivers: they follow a fixed trace the caller has sized,
+        assert zero drops afterwards, and must not have live back-pressure
         perturb trace-following (the HTTP handlers always keep it on).
 
         Returns ``(rejected_indices, reasons, accepted, depth)``."""
@@ -590,6 +737,7 @@ class ServingScheduler(Service):
         rejected: list[int] = []
         reasons: set[str] = set()
         wal_recs: list[dict] = []
+        filled = False
         with self._stage_lock:
             # the snapshot must be read under the SAME lock hold as the
             # unseen counters: _refresh_snapshot swaps the snapshot and
@@ -599,7 +747,8 @@ class ServingScheduler(Service):
             # jobs and re-opening the silent-drop hole
             snap = self._snap
             room = self.max_staged - self._staged_jobs
-            budget: dict[int, int] = {}
+            budget: dict[tuple, int] = {}
+            qleft: dict[int, int] = {}
             tick = self.cfg.tick_ms
             stamp = (self._stage_t + 1) * tick if ta is None else int(ta)
             if ta is not None:
@@ -607,25 +756,44 @@ class ServingScheduler(Service):
                 assert dest == self._stage_t, (
                     f"ta={stamp} buckets to tick {dest}, open tick is "
                     f"{self._stage_t} — pace seal_tick() to the stream")
-            for idx, (c, jid, cores, mem, gpu, dur, delay) in \
+            for idx, (tn, c, jid, cores, mem, gpu, dur, delay) in \
                     enumerate(jobs):
                 if room <= 0:
                     rejected.append(idx)
                     reasons.add("max_staged")
+                    self._rejected_t[tn] += 1
                     continue
                 if live_bounds:
-                    if c not in budget:
-                        budget[c] = (self.cfg.queue_capacity
-                                     - int(snap.queue_depth[c])
-                                     - int(self._unseen[c]))
-                    if budget[c] <= 0:
+                    if (self._quota is not None
+                            and self._quota[tn] >= 0):
+                        if tn not in qleft:
+                            qleft[tn] = (int(self._quota[tn])
+                                         - int(snap.depth_tc[tn].sum())
+                                         - int(self._unseen[tn].sum()))
+                        if qleft[tn] <= 0:
+                            rejected.append(idx)
+                            reasons.add("quota")
+                            self._rejected_t[tn] += 1
+                            continue
+                    if (tn, c) not in budget:
+                        budget[(tn, c)] = (self.cfg.queue_capacity
+                                           - int(snap.depth_tc[tn, c])
+                                           - int(self._unseen[tn, c]))
+                    if budget[(tn, c)] <= 0:
                         rejected.append(idx)
                         reasons.add("queue")
+                        self._rejected_t[tn] += 1
                         continue
                 parked = delay != self._delay_policy
-                if not parked and len(self._open[c]) >= self.k_cap:
+                if parked and self.T > 1:
+                    raise ValueError(
+                        "mismatched-endpoint routing (parked jobs) is "
+                        "single-tenant Go-wire parity — handlers answer "
+                        "400 before staging under multi-tenant hosting")
+                if not parked and len(self._open[tn][c]) >= self.k_cap:
                     rejected.append(idx)
                     reasons.add("k_cap")
+                    self._rejected_t[tn] += 1
                     continue
                 row = make_row(jid, cores, mem, gpu, dur, stamp)
                 if parked:
@@ -635,14 +803,19 @@ class ServingScheduler(Service):
                     # job sits forever)
                     self._parked.append((c, row, delay))
                 else:
-                    self._open[c].append(row)
+                    self._open[tn][c].append(row)
+                    if (self.adaptive_window
+                            and len(self._open[tn][c]) >= self.k_cap):
+                        filled = True  # seal early once the lock is off
                 self._staged_jobs += 1
-                self._unseen[c] += 1
+                self._unseen[tn, c] += 1
                 if live_bounds:
-                    budget[c] -= 1
+                    budget[(tn, c)] -= 1
+                    if tn in qleft:
+                        qleft[tn] -= 1
                 room -= 1
                 if self.track_latency:
-                    self._submit_wall[(c, jid)] = now
+                    self._submit_wall[(tn, c, jid)] = now
                 if self._wal is not None and not self._replaying:
                     rec = {"c": c, "i": int(jid), "co": int(cores),
                            "m": int(mem), "g": int(gpu), "du": int(dur),
@@ -672,6 +845,13 @@ class ServingScheduler(Service):
                 if any(r.get("p") for r in wal_recs):
                     self._wal_parked = True
                 self._wal.append(wal_recs)
+        if filled:
+            # adaptive early seal: a bucket at k_cap means the open tick
+            # already carries a full dispatch-K of work — sealing now (off
+            # the lock; seal_tick re-acquires) hands it to the drive loop
+            # instead of letting it ripen a full pacer period while new
+            # arrivals bounce off k_cap
+            self.seal_tick()
         if rejected:
             self.meter.add("submit_rejected", len(rejected))
         return rejected, reasons, len(jobs) - len(rejected), depth
@@ -687,7 +867,7 @@ class ServingScheduler(Service):
 
     def submit_direct(self, c: int, jid: int, cores: int, mem: int,
                       dur_ms: int, gpu: int = 0, delay: Optional[bool] = None,
-                      ta: Optional[int] = None) -> bool:
+                      ta: Optional[int] = None, tenant: int = 0) -> bool:
         """Driver-side staging without the HTTP hop (tests, fuzz drivers)
         — one job through the same ``_stage`` core the handlers use, with
         the queue-budget bound off (``live_bounds=False``): deterministic
@@ -699,7 +879,7 @@ class ServingScheduler(Service):
         buckets."""
         delay = self._delay_policy if delay is None else delay
         rejected, _reasons, _acc, _depth = self._stage(
-            [(c, jid, cores, mem, gpu, dur_ms, delay)], ta=ta,
+            [(int(tenant), c, jid, cores, mem, gpu, dur_ms, delay)], ta=ta,
             live_bounds=False)
         return not rejected
 
@@ -709,8 +889,10 @@ class ServingScheduler(Service):
         cadence; deterministic drivers call it directly."""
         with self._stage_lock:
             self._sealed.append(self._open)
-            self._open = [[] for _ in range(self.C)]
+            self._open = [[[] for _ in range(self.C)]
+                          for _ in range(self.T)]
             self._stage_t += 1
+            self._sealed_walls.append(time.time())
 
     # ------------------------------------------------------------------
     # crash recovery: WAL + atomic checkpoints (services/wal.py)
@@ -890,7 +1072,7 @@ class ServingScheduler(Service):
                         self.seal_tick()
                     ta = stamp
                 rej, _r, _a, _d = self._stage(
-                    [(int(rec["c"]), int(rec["i"]), int(rec["co"]),
+                    [(0, int(rec["c"]), int(rec["i"]), int(rec["co"]),
                       int(rec["m"]), int(rec["g"]), int(rec["du"]),
                       bool(rec["dl"]))], ta=ta, live_bounds=False)
                 if rej:
@@ -971,27 +1153,33 @@ class ServingScheduler(Service):
                 return k
         return round_up_pow2(need)
 
-    def _pop_chunk(self, T: int):
+    def _pop_chunk(self, W: int):
         with self._stage_lock:
-            ticks = self._sealed[:T]
-            del self._sealed[:T]
+            ticks = self._sealed[:W]
+            del self._sealed[:W]
+            for _ in range(min(W, len(self._sealed_walls))):
+                self._sealed_walls.popleft()
             parked, self._parked = self._parked, []
-            n = sum(len(lst) for tk in ticks for lst in tk) + len(parked)
+            n = sum(len(lst) for tk in ticks for row in tk
+                    for lst in row) + len(parked)
             self._staged_jobs -= n
         # dispatched jobs stay in _unseen (the admission bound's view of
         # the device queues) until a snapshot shows them; _inflight is
         # drive-thread-owned bookkeeping of that handoff
         for tk in ticks:
-            for c, lst in enumerate(tk):
-                self._inflight[c] += len(lst)
+            for tn, row in enumerate(tk):
+                for c, lst in enumerate(row):
+                    self._inflight[tn, c] += len(lst)
         for c, _row, _d in parked:
-            self._inflight[c] += 1
+            self._inflight[0, c] += 1
         return ticks, parked, n
 
-    def _dispatch(self, T: int) -> int:
-        """Consume T sealed ticks as ONE device dispatch. Returns the
-        number of jobs dispatched."""
-        ticks, parked, n_jobs = self._pop_chunk(T)
+    def _dispatch(self, W: int) -> int:
+        """Consume W sealed ticks as ONE device dispatch (all hosted
+        tenants advance together: the tenant axis rides the stacked
+        rows, not extra dispatches). Returns the number of jobs
+        dispatched."""
+        ticks, parked, n_jobs = self._pop_chunk(W)
         # mismatched-endpoint jobs enter the queue their endpoint names
         # (which the policy ignores — inert rows, so applying them at the
         # chunk edge instead of mid-chunk is invisible to placement;
@@ -1004,30 +1192,53 @@ class ServingScheduler(Service):
             op = host_ops.push_l0_at if delay else host_ops.push_ready_at
             self._state = op(self._state,
                              np.asarray(row, np.int32), np.int32(c))
-        kmax = max((len(lst) for tk in ticks for lst in tk), default=0)
+        kmax = max((len(lst) for tk in ticks for row in tk for lst in row),
+                   default=0)
         K = self._pick_k(max(kmax, 1))
-        rows = np.broadcast_to(np.asarray(Q._INVALID_ROW),
-                               (T, self.C, K, Q.NF)).copy()
-        counts = np.zeros((T, self.C), np.int32)
-        for ti, tk in enumerate(ticks):
-            for c, lst in enumerate(tk):
-                if lst:
-                    counts[ti, c] = len(lst)
-                    rows[ti, c, :len(lst)] = np.asarray(lst, np.int32)
         run_io, timed = self._pricing_exec()
         t_in = time.perf_counter() if timed else 0.0
-        with annotate_dispatch("serving", ticks=T, jobs=n_jobs):
-            if self.obs:
-                self._state, io, self._mbuf = run_io(
-                    self._state, rows, counts, None, self._mbuf)
-            else:
-                self._state, io = run_io(self._state, rows, counts)
+        if self.T > 1:
+            # tenant-batched dispatch: rows [T, W, C, K, NF] feed the ONE
+            # vmapped executable with the traced TenantParams stack
+            rows = np.broadcast_to(np.asarray(Q._INVALID_ROW),
+                                   (self.T, W, self.C, K, Q.NF)).copy()
+            counts = np.zeros((self.T, W, self.C), np.int32)
+            for ti, tk in enumerate(ticks):
+                for tn, trow in enumerate(tk):
+                    for c, lst in enumerate(trow):
+                        if lst:
+                            counts[tn, ti, c] = len(lst)
+                            rows[tn, ti, c, :len(lst)] = np.asarray(
+                                lst, np.int32)
+            with annotate_dispatch("serving", ticks=W, jobs=n_jobs):
+                if self.obs:
+                    self._state, io, self._mbuf = run_io(
+                        self._state, rows, counts, self._tp, self._mbuf)
+                else:
+                    self._state, io = run_io(
+                        self._state, rows, counts, self._tp)
+        else:
+            rows = np.broadcast_to(np.asarray(Q._INVALID_ROW),
+                                   (W, self.C, K, Q.NF)).copy()
+            counts = np.zeros((W, self.C), np.int32)
+            for ti, tk in enumerate(ticks):
+                for c, lst in enumerate(tk[0]):
+                    if lst:
+                        counts[ti, c] = len(lst)
+                        rows[ti, c, :len(lst)] = np.asarray(lst, np.int32)
+            with annotate_dispatch("serving", ticks=W, jobs=n_jobs):
+                if self.obs:
+                    self._state, io, self._mbuf = run_io(
+                        self._state, rows, counts, None, self._mbuf)
+                else:
+                    self._state, io = run_io(self._state, rows, counts)
         if timed:
             # the budget needs the device finished — the one deliberate
             # sync a budgeted pricing dispatch pays (see ctor comment)
             jax.block_until_ready(self._state.t)
-            self._pricing_account(T, (time.perf_counter() - t_in) * 1000.0)
-        self.ticks_dispatched += T
+            self._pricing_account(W, (time.perf_counter() - t_in) * 1000.0)
+        self._dispatch_walls.append(time.time())
+        self.ticks_dispatched += W
         self.dispatches += 1
         self._parked_applied += len(parked)
         self.batch_jobs.append(n_jobs)
@@ -1129,7 +1340,8 @@ class ServingScheduler(Service):
         edge: everything dispatched so far is host-visible once the swap
         below lands, so the (ticks, wall) pair is appended after it."""
         s = self._state
-        inflight, self._inflight = self._inflight, np.zeros(self.C, np.int64)
+        inflight, self._inflight = (self._inflight,
+                                    np.zeros((self.T, self.C), np.int64))
         queues = (s.l0, s.l1, s.ready, s.wait)
         t, placed_c, jq, qd, running, aw, dr = self._snap_probe(s)
         # np.array, NOT np.asarray: on the CPU backend asarray returns a
@@ -1138,19 +1350,52 @@ class ServingScheduler(Service):
         # must own its memory or its readers see silently-recycled bytes
         placed = np.array(placed_c)
         depth = np.array(qd)
-        payload = dict(
-            wall=time.time(), sim_t=int(np.asarray(t)),
-            placed_total=placed, placed=int(placed.sum()),
-            jobs_in_queue=np.array(jq),
-            queue_depth=depth,
-            running=np.array(running),
-            avg_wait_ms=np.array(aw),
-            drops=dict(zip(self._DROP_KEYS,
-                           np.asarray(dr).tolist())),
-            queue_ids=[np.array(q.id) for q in queues],
-            run_ids=np.array(s.run.id),
-            run_active=np.array(s.run.active),
-            dispatches=self.dispatches)
+        if self.T > 1:
+            # tenant-stacked probe leaves ([T], [T, C]): the legacy
+            # constellation-level slots become cross-tenant aggregates
+            # (sums; avg_wait a plain mean — /quote answers per tenant),
+            # the *_tc slots keep the per-tenant rows the admission
+            # bound and the tenant queries read. All tenants advance in
+            # lockstep, so any row's clock is THE clock.
+            jq_tc, run_tc, aw_tc = (np.array(jq), np.array(running),
+                                    np.array(aw))
+            payload = dict(
+                wall=time.time(), sim_t=int(np.asarray(t)[0]),
+                placed_total=placed.sum(axis=0), placed=int(placed.sum()),
+                jobs_in_queue=jq_tc.sum(axis=0),
+                queue_depth=depth.sum(axis=0),
+                running=run_tc.sum(axis=0),
+                avg_wait_ms=aw_tc.mean(axis=0),
+                drops=dict(zip(self._DROP_KEYS,
+                               np.asarray(dr).sum(axis=0).tolist())),
+                queue_ids=[np.array(q.id) for q in queues],
+                run_ids=np.array(s.run.id),
+                run_active=np.array(s.run.active),
+                dispatches=self.dispatches,
+                tenants=self.T, depth_tc=depth,
+                placed_t=placed.sum(axis=1), running_tc=run_tc,
+                jobs_in_queue_tc=jq_tc, avg_wait_tc=aw_tc)
+        else:
+            payload = dict(
+                wall=time.time(), sim_t=int(np.asarray(t)),
+                placed_total=placed, placed=int(placed.sum()),
+                jobs_in_queue=np.array(jq),
+                queue_depth=depth,
+                running=np.array(running),
+                avg_wait_ms=np.array(aw),
+                drops=dict(zip(self._DROP_KEYS,
+                               np.asarray(dr).tolist())),
+                queue_ids=[np.array(q.id) for q in queues],
+                run_ids=np.array(s.run.id),
+                run_active=np.array(s.run.active),
+                dispatches=self.dispatches,
+                tenants=1, depth_tc=depth[None],
+                placed_t=placed.sum(keepdims=True))
+            # single-tenant rows are views of the owned aggregates (no
+            # second coercion): the tenant axis is just [1, ...]
+            payload["running_tc"] = payload["running"][None]
+            payload["jobs_in_queue_tc"] = payload["jobs_in_queue"][None]
+            payload["avg_wait_tc"] = payload["avg_wait_ms"][None]
         prev = self._snap
         with self._stage_lock:
             # the unseen decrement and the snapshot swap are ONE atomic
@@ -1185,6 +1430,21 @@ class ServingScheduler(Service):
         m.set_gauge("ticks_dispatched", float(self.ticks_dispatched))
         m.set_gauge("rejected_503", float(self._rejected_count()))
         m.set_gauge("sim_t_ms", float(s.sim_t))
+        if self.T > 1:
+            # per-tenant rows as labeled series off the SAME snapshot —
+            # one harvest, T label values; /metrics renders them via
+            # telemetry.prom_split_labels (never a per-tenant device sync)
+            with self._stage_lock:
+                rej_t = self._rejected_t.copy()
+            for tn in range(self.T):
+                lbl = f'{{tenant="{tn}"}}'
+                m.set_gauge(f"tenant_placed_total{lbl}",
+                            float(s.placed_t[tn]))
+                m.set_gauge(f"tenant_queue_depth{lbl}",
+                            float(s.depth_tc[tn].sum()))
+                m.set_gauge(f"tenant_running{lbl}",
+                            float(s.running_tc[tn].sum()))
+                m.set_gauge(f"tenant_rejected_503{lbl}", float(rej_t[tn]))
         if prev is not None:
             # the retiring snapshot's final age — how stale queries could
             # have seen the surface this window (gauge + distribution)
@@ -1192,7 +1452,28 @@ class ServingScheduler(Service):
             m.set_gauge("snapshot_age_ms", round(age, 3))
             m.record("snapshot_age_ms_hist", age)
         if self.obs and self._mbuf is not None:
-            h = obs_device.harvest(self._mbuf)
+            if self.T > 1:
+                # one coercion for the whole stacked buffer, then cheap
+                # per-tenant harvests over host views
+                host_mb = jax.tree.map(np.array, self._mbuf)
+                cells = [obs_device.harvest(
+                    self._tenancy.tenant_cell(host_mb, tn))
+                    for tn in range(self.T)]
+                h = {
+                    "ticks": cells[0]["ticks"],  # shared dispatch clock
+                    "placed": sum(c["placed"] for c in cells),
+                    "arrived": sum(c["arrived"] for c in cells),
+                    "wait_accrued_ms": round(sum(
+                        c["wait_accrued_ms"] for c in cells), 3),
+                    "narrow_ovf": sum(c["narrow_ovf"] for c in cells),
+                    "queue_depth_max": max(
+                        c["queue_depth_max"] for c in cells),
+                }
+                for tn, c in enumerate(cells):
+                    m.set_gauge(f'tenant_obs_placed{{tenant="{tn}"}}',
+                                float(c["placed"]))
+            else:
+                h = obs_device.harvest(self._mbuf)
             self._obs_harvest = h
             m.set_gauge("obs_ticks", float(h["ticks"]))
             m.set_gauge("obs_placed", float(h["placed"]))
@@ -1245,16 +1526,29 @@ class ServingScheduler(Service):
             # pricing budget reaches for it — a mid-traffic XLA compile on
             # the escape path would itself blow the window it rescues
             execs.append(self._run_io_fallback)
-        for K in ks:
-            rows = np.broadcast_to(
-                np.asarray(Q._INVALID_ROW),
-                (self.window, self.C, int(K), Q.NF)).copy()
-            counts = np.zeros((self.window, self.C), np.int32)
+        windows = [self.window]
+        if self.adaptive_window and self.window > 1:
+            windows.append(1)  # the early-dispatch shape (_adaptive_due)
+        for W, K in ((w, k) for w in windows for k in ks):
+            if self.T > 1:
+                rows = np.broadcast_to(
+                    np.asarray(Q._INVALID_ROW),
+                    (self.T, W, self.C, int(K), Q.NF)).copy()
+                counts = np.zeros((self.T, W, self.C), np.int32)
+            else:
+                rows = np.broadcast_to(
+                    np.asarray(Q._INVALID_ROW),
+                    (W, self.C, int(K), Q.NF)).copy()
+                counts = np.zeros((W, self.C), np.int32)
             for run_io in execs:
                 clone = jax.tree.map(jnp.copy, self._state)
                 if self.obs:  # warm the executable shape the live path calls
                     mb = jax.tree.map(jnp.copy, self._mbuf)
-                    out, _io, _mb = run_io(clone, rows, counts, None, mb)
+                    out, _io, _mb = run_io(
+                        clone, rows, counts,
+                        self._tp if self.T > 1 else None, mb)
+                elif self.T > 1:
+                    out, _io = run_io(clone, rows, counts, self._tp)
                 else:
                     out, _io = run_io(clone, rows, counts)
                 jax.block_until_ready(out.t)  # compile-only: clone discarded
@@ -1387,14 +1681,44 @@ class ServingScheduler(Service):
                 self.seal_tick()
             time.sleep(min(max(period / 2, 0.0005), 0.02))
 
+    def _adaptive_due(self) -> int:
+        """Sealed ticks to dispatch NOW under adaptive windows: the full
+        window when one is ready, else ONE tick once the oldest sealed
+        tick has waited past the deadline (tail-latency escape hatch — a
+        light-traffic tick stops idling out the whole window wall).
+        Single-tick granularity on the early path keeps the executable
+        zoo at two (W, K) shape families — arbitrary partial widths
+        would each compile mid-traffic. 0 = wait."""
+        with self._stage_lock:
+            n = len(self._sealed)
+            oldest = self._sealed_walls[0] if self._sealed_walls else None
+        if n >= self.window:
+            return self.window
+        if n == 0 or oldest is None:
+            return 0
+        deadline = self.adaptive_deadline_ms
+        if deadline is None:
+            deadline = max(self._window_wall_ms() / 4.0, 1.0)
+        return 1 if (time.time() - oldest) * 1000.0 >= deadline else 0
+
     def _drive_loop(self) -> None:
         """Dispatch a coalesce window whenever one is sealed — back-to-
         back when the backlog is deep (throughput degrades to
         device-bound, never to drops), idle-waiting when traffic is
-        light."""
+        light. With ``adaptive_window`` armed, partial windows whose
+        oldest sealed tick has aged past the deadline dispatch early
+        (p99 under light load stops paying the full window wall; the
+        early-seal half lives in ``_stage``: a full k_cap bucket seals
+        its tick without waiting for the pacer)."""
         period = self.cfg.tick_ms / 1000.0 / self.speed
         while not self._stop.is_set():
-            if self._sealed_count() >= self.window:
+            if self.adaptive_window:
+                due = self._adaptive_due()
+                if due > 0:
+                    self._dispatch(due)
+                else:
+                    time.sleep(min(max(period / 4, 0.0005), 0.005))
+            elif self._sealed_count() >= self.window:
                 self._dispatch(self.window)
             else:
                 time.sleep(min(max(period, 0.001), 0.02))
@@ -1418,6 +1742,12 @@ class ServingScheduler(Service):
                 pricing_fallbacks=self.pricing_fallbacks,
                 pricing_fallback_active=self._pricing_fallback),
             "coalesce_window_ticks": self.window,
+            "adaptive_window": self.adaptive_window,
+            "adaptive_deadline_ms": self.adaptive_deadline_ms,
+            "tenants": self.T,
+            "tenant_params_digest": (
+                self._tenancy.tenant_params_digest(self._tp)
+                if self._tp is not None else None),
             "clusters": self.C, "k_cap": self.k_cap,
             "max_staged": self.max_staged,
             "snapshot_every": self.snapshot_every,
@@ -1450,19 +1780,26 @@ class ServingScheduler(Service):
         if not self.track_latency:
             return []
         from multi_cluster_simulator_tpu.utils.trace import extract_trace
-        trace = extract_trace(self._state)
         log = self.visibility_log
         tick = self.cfg.tick_ms
         out = []
         with self._stage_lock:
             submit = dict(self._submit_wall)
-        for c, events in enumerate(trace):
-            for (t, jid, node, src) in events:
-                t0 = submit.get((c, jid))
-                if t0 is None:
-                    continue
-                # first snapshot whose dispatched ticks cover clock t
-                wall = next((w for (n, w) in log if n * tick >= t), None)
-                if wall is not None:
-                    out.append((wall - t0) * 1000.0)
+        if self.T > 1:
+            host = jax.tree.map(np.array, self._state)
+            cells = [self._tenancy.tenant_cell(host, tn)
+                     for tn in range(self.T)]
+        else:
+            cells = [self._state]
+        for tn, cell in enumerate(cells):
+            for c, events in enumerate(extract_trace(cell)):
+                for (t, jid, node, src) in events:
+                    t0 = submit.get((tn, c, jid))
+                    if t0 is None:
+                        continue
+                    # first snapshot whose dispatched ticks cover clock t
+                    wall = next((w for (n, w) in log if n * tick >= t),
+                                None)
+                    if wall is not None:
+                        out.append((wall - t0) * 1000.0)
         return out

@@ -439,6 +439,15 @@ def prom_metric_name(name: str) -> str:
     return ("_" + n) if n[:1].isdigit() else n
 
 
+def prom_split_labels(key: str) -> tuple[str, str]:
+    """Split a metric key into (name, label-suffix). Keys may carry a
+    Prometheus label set verbatim — ``placed_total{tenant="3"}`` — which
+    the name sanitizer must NOT eat (it would mangle the braces to
+    underscores); only the name half passes through prom_metric_name."""
+    base, brace, rest = key.partition("{")
+    return base, (brace + rest) if brace else ""
+
+
 class Meter:
     """Counters + histograms with periodic export.
 
@@ -509,16 +518,18 @@ class Meter:
         le-buckets."""
         snap = self.snapshot()
         lines = []
-        for k, v in snap["counters"].items():
-            full = prom_metric_name(f"{self.service}_{k}")
-            lines.append(f"# HELP {full} up/down counter {k} of {self.service}")
-            lines.append(f"# TYPE {full} gauge")
-            lines.append(f"{full} {v}")
-        for k, v in snap["gauges"].items():
-            full = prom_metric_name(f"{self.service}_{k}")
-            lines.append(f"# HELP {full} gauge {k} of {self.service}")
-            lines.append(f"# TYPE {full} gauge")
-            lines.append(f"{full} {v}")
+        seen = set()  # one HELP/TYPE per metric family (labeled series share)
+        for kind, table in (("up/down counter", snap["counters"]),
+                            ("gauge", snap["gauges"])):
+            for k, v in table.items():
+                base, labels = prom_split_labels(k)
+                full = prom_metric_name(f"{self.service}_{base}")
+                if full not in seen:
+                    seen.add(full)
+                    lines.append(
+                        f"# HELP {full} {kind} {base} of {self.service}")
+                    lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full}{labels} {v}")
         for k, h in snap["histograms"].items():
             full = prom_metric_name(f"{self.service}_{k}")
             lines.append(f"# HELP {full} histogram {k} of {self.service}")

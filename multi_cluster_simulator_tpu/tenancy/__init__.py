@@ -1,0 +1,20 @@
+"""Multi-tenant constellation hosting: the tenant axis (ROADMAP item 3).
+
+``TenantParams`` (params.py) holds everything that varies per tenant as
+traced leaves; ``TenantBatch`` (host.py) vmaps the engine's drivers over
+a leading tenant axis — T independent constellations, one compiled
+program, donated stacked state, per-tenant fault streams, mesh sharding
+via pytree-prefix replication. The serving front door hosts the batch
+behind per-tenant routing/quota/stats (services/serving.py); bench.py
+``--tenants`` records the aggregate-throughput row.
+"""
+
+from multi_cluster_simulator_tpu.tenancy.host import (  # noqa: F401
+    TenantBatch, aggregate_drops, aggregate_placed, init_tenant_state,
+    n_tenants, pad_tick_arrivals, shard_tenant_batch, stack_tenant_states,
+    stack_tick_arrivals, tenant_cell,
+)
+from multi_cluster_simulator_tpu.tenancy.params import (  # noqa: F401
+    TenantParams, default_tenant_params, stack_tenant_params,
+    tenant_params_digest,
+)

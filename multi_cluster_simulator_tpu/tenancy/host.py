@@ -1,0 +1,259 @@
+"""The tenant axis: vmapped engine drivers over stacked constellations.
+
+One device mesh hosts T independent tenants — each its own ``SimState``
+cell, policy/market knobs (``TenantParams.policy`` leaves), generative
+fault stream (``TenantParams.fault_seed``), and arrival trace — through
+ONE compiled program: ``jax.vmap`` of the engine's existing drivers over
+a leading tenant axis, exactly the way envs/cluster_env.py batches env
+instances. Donated stacked state, traced per-tenant params, jit
+cache == 1 for any T (tests/test_tenancy.py asserts the count).
+
+Parity is the contract that makes the axis safe (PARITY.md): vmap of a
+pure function is the function per lane, so every tenant cell of a T>1
+run is bit-identical to its standalone single-tenant run — composed
+with the compact layout (``plan``), event-compressed time
+(``run_compressed_fn``), generative faults, and mesh sharding
+(``shard_tenant_batch``'s pytree-prefix placement, no collectives:
+tenants are independent, so data-parallel jit needs no shard_map).
+
+Cross-tenant data flow is FORBIDDEN outside the sanctioned aggregate
+helpers below (``aggregate_*``) — simlint family 13 ``tenant-isolation``
+(LINTING.md §13) machine-checks the scope.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.core import state as st
+from multi_cluster_simulator_tpu.core.engine import Engine
+from multi_cluster_simulator_tpu.core.state import SimState, init_state
+from multi_cluster_simulator_tpu.tenancy.params import (
+    TenantParams, default_tenant_params, stack_tenant_params,
+)
+
+
+def n_tenants(tp: TenantParams) -> int:
+    """Tenant count of a stacked params pytree (0-d leaves = one cell)."""
+    idx = jnp.asarray(tp.policy.idx)
+    return int(idx.shape[0]) if idx.ndim else 1
+
+
+def init_tenant_state(cfg: SimConfig, specs, tp: Optional[TenantParams] = None,
+                      plan=None) -> SimState:
+    """One tenant's reset constellation — the SAME init the standalone
+    reference run uses, so stacked cells and standalone states start
+    bit-identical. Leaves are cloned (init_state shares zero-filled
+    buffers, which a donating dispatch may not receive twice), and with
+    generative faults armed the churn streams reseed from the tenant's
+    ``fault_seed`` leaf: per-tenant failure patterns from one shared
+    FaultConfig shape (the envs/ reset discipline)."""
+    state = jax.tree.map(jnp.copy, init_state(cfg, specs, plan=plan))
+    if tp is not None and cfg.faults.enabled and cfg.faults.mode != "trace":
+        from multi_cluster_simulator_tpu.faults import schedule as fsch
+        key = jax.random.PRNGKey(jnp.asarray(tp.fault_seed, jnp.uint32))
+        state = state.replace(faults=fsch.reseed(
+            state.faults, key, cfg.faults, eligible=state.node_active))
+    return state
+
+
+def stack_tenant_states(cells: Sequence[SimState]) -> SimState:
+    """Stack per-tenant states leaf-wise on a leading [T] axis."""
+    if not cells:
+        raise ValueError("stack_tenant_states needs at least one tenant")
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *cells)
+
+
+def tenant_cell(tree, i: int):
+    """Extract tenant ``i``'s cell from any tenant-stacked pytree (host
+    side: parity probes, snapshots — never inside the traced program)."""
+    return jax.tree.map(lambda leaf: leaf[i], tree)
+
+
+def shard_tenant_batch(tree, mesh, axis: str = "tenants"):
+    """Shard a tenant-stacked pytree over ``mesh``'s ``axis``: every leaf
+    splits on its leading (tenant) dimension via the same pytree-prefix
+    placement the cluster mesh and the env batch use
+    (parallel/sharded_engine._device_put_tree). Tenants are independent,
+    so data-parallel jit needs no shard_map and no new collectives —
+    results are bitwise identical to the unsharded batch."""
+    from jax.sharding import PartitionSpec as P
+
+    from multi_cluster_simulator_tpu.parallel.mesh import nearest_divisible
+    from multi_cluster_simulator_tpu.parallel.sharded_engine import (
+        _device_put_tree,
+    )
+
+    n = mesh.shape[axis]
+    lead = jax.tree.leaves(tree)[0].shape[0]
+    if lead % n != 0:
+        lo, hi = nearest_divisible(lead, n)
+        valid = f"{hi}" if lo == 0 else f"{lo} or {hi}"
+        raise ValueError(
+            f"tenant batch ({lead}) must divide by mesh size ({n}); "
+            f"nearest valid tenant counts: {valid}")
+    return _device_put_tree(tree, P(axis), mesh)
+
+
+class TenantBatch:
+    """Batched multi-tenant drivers over one ``Engine``.
+
+    The engine is shared (one config shape, one policy set — selection
+    and hyperparameters are per-tenant TRACED leaves); only the state,
+    arrivals, and params carry the tenant axis. Every ``*_fn`` builder
+    returns a callable with the compiled program on ``._jit`` — the
+    jit-cache-count probe surface (the envs/ ``batch_step_fn``
+    convention, audited by tools/simtrace entry ``tenancy.run_io``)."""
+
+    def __init__(self, cfg: SimConfig, specs, policies=None, plan=None):
+        self.cfg = cfg
+        self.specs = list(specs)
+        self.plan = plan
+        self.engine = Engine(cfg, policies=policies)
+
+    # -- construction ------------------------------------------------------
+    def default_params(self, T: int, name: Optional[str] = None,
+                       fault_seed0: int = 0) -> TenantParams:
+        """T identical-default tenants with DISTINCT fault seeds — the
+        baseline a caller then perturbs leaf-wise per tenant. ``name``
+        picks a member of this batch's PolicySet (the engine's set)."""
+        cells = [default_tenant_params(self.cfg, pset=self.engine.pset,
+                                       name=name, fault_seed=fault_seed0 + i)
+                 for i in range(T)]
+        return stack_tenant_params(cells)
+
+    def init_stacked(self, tp: TenantParams) -> SimState:
+        """The stacked reset constellation for every tenant in ``tp``."""
+        T = n_tenants(tp)
+        stacked = jnp.asarray(tp.policy.idx).ndim > 0
+        return stack_tenant_states([
+            init_tenant_state(self.cfg, self.specs, tenant_cell(tp, i)
+                              if stacked else tp, plan=self.plan)
+            for i in range(T)])
+
+    # -- batched drivers ---------------------------------------------------
+    def run_io_fn(self, donate: bool = True, obs: bool = False):
+        """The tenant-batched dispatch unit: vmapped ``Engine.run_io``
+        over (state, rows, counts, params[, mbuf]) — rows stacked to
+        [T, Tt, C, K, NF], counts [T, Tt, C]. One executable for any
+        tenant count at a fixed (T, Tt, K) shape; donated stacked state
+        (the serving tier's dispatch contract, now with a tenant axis)."""
+        eng = self.engine
+
+        if obs:
+            def cell(state, rows, counts, tp, mbuf):
+                return eng.run_io(state, rows, counts, params=tp.policy,
+                                  mbuf=mbuf)
+
+            fn = jax.jit(jax.vmap(cell, in_axes=(0, 0, 0, 0, 0)),
+                         donate_argnums=(0,) if donate else ())
+
+            def call(state, rows, counts, tp, mbuf):
+                return fn(state, rows, counts, tp, mbuf)
+        else:
+            def cell(state, rows, counts, tp):
+                return eng.run_io(state, rows, counts, params=tp.policy)
+
+            fn = jax.jit(jax.vmap(cell, in_axes=(0, 0, 0, 0)),
+                         donate_argnums=(0,) if donate else ())
+
+            def call(state, rows, counts, tp):
+                return fn(state, rows, counts, tp)
+
+        call._jit = fn
+        return call
+
+    def run_fn(self, n_ticks: int, donate: bool = True):
+        """Vmapped tick-indexed ``Engine.run`` over stacked TickArrivals
+        (the batch tier's form: [T]-stacked ``rows``/``counts``,
+        ``n_ticks`` static and shared — ticks are a shape)."""
+        eng = self.engine
+
+        def cell(state, ta, tp):
+            return eng.run(state, ta, n_ticks, params=tp.policy)
+
+        fn = jax.jit(jax.vmap(cell, in_axes=(0, 0, 0)),
+                     donate_argnums=(0,) if donate else ())
+
+        def call(state, ta, tp):
+            return fn(state, ta, tp)
+
+        call._jit = fn
+        return call
+
+    def run_compressed_fn(self, n_ticks: int, donate: bool = True):
+        """Vmapped event-compressed driver: each tenant leaps its own
+        quiescent gaps (the batched while_loop masks finished lanes, so
+        a leaping tenant never perturbs a dense one — bit-identical per
+        cell to the standalone compressed run)."""
+        eng = self.engine
+
+        def cell(state, ta, tp):
+            out = eng.run_compressed(state, ta, n_ticks, params=tp.policy)
+            return out[0] if isinstance(out, tuple) else out
+
+        fn = jax.jit(jax.vmap(cell, in_axes=(0, 0, 0)),
+                     donate_argnums=(0,) if donate else ())
+
+        def call(state, ta, tp):
+            return fn(state, ta, tp)
+
+        call._jit = fn
+        return call
+
+
+def stack_tick_arrivals(tas: Sequence[st.TickArrivals]) -> st.TickArrivals:
+    """Stack per-tenant bucketed streams on a leading [T] axis. All
+    tenants must share one (Tt, C, K) shape — pad K to the tenant-max
+    bucket first (the grid-global-K move from tools/tournament.py)."""
+    shapes = {tuple(np.asarray(ta.rows).shape) for ta in tas}
+    if len(shapes) != 1:
+        raise ValueError(
+            f"tenant streams must share one (Tt, C, K, NF) shape before "
+            f"stacking; got {sorted(shapes)} — pad K to the tenant-max "
+            "bucket (pad_tick_arrivals)")
+    return st.TickArrivals(
+        rows=jnp.stack([jnp.asarray(ta.rows) for ta in tas]),
+        counts=jnp.stack([jnp.asarray(ta.counts) for ta in tas]))
+
+
+def pad_tick_arrivals(ta: st.TickArrivals, k: int) -> st.TickArrivals:
+    """Pad a bucketed stream's K axis to the shared tenant-max bucket
+    with invalid rows (ingest masks rows beyond each tick's count, so
+    wider padding is semantically invisible)."""
+    from multi_cluster_simulator_tpu.ops import queues as Q
+    rows, counts = np.asarray(ta.rows), np.asarray(ta.counts)
+    k0 = rows.shape[2]
+    if k0 > k:
+        raise ValueError(f"stream K {k0} exceeds the shared bucket {k}")
+    if k0 == k:
+        return st.TickArrivals(rows=rows, counts=counts)
+    pad = np.broadcast_to(np.asarray(Q._INVALID_ROW),
+                          rows.shape[:2] + (k - k0, rows.shape[3])).copy()
+    return st.TickArrivals(rows=np.concatenate([rows, pad], axis=2),
+                           counts=counts)
+
+
+# ---------------------------------------------------------------------------
+# sanctioned cross-tenant aggregate sites (LINTING.md §13): the ONLY places
+# a reduction may cross the tenant axis — everything else in tenancy/ is
+# per-tenant by construction, and simlint's tenant-isolation family flags
+# any reduction or cross-row indexing outside these functions.
+# ---------------------------------------------------------------------------
+
+def aggregate_placed(stacked_state: SimState) -> int:
+    """Total placed jobs across every tenant (host-side, post-run)."""
+    stacked_placed = np.asarray(stacked_state.placed_total)
+    return int(np.sum(stacked_placed))
+
+
+def aggregate_drops(stacked_state: SimState) -> dict:
+    """Summed drop counters across tenants — the zero-drops gate's view
+    (any nonzero names the tenant in the per-cell probe, not here)."""
+    from multi_cluster_simulator_tpu.utils.trace import total_drops
+    return total_drops(stacked_state)

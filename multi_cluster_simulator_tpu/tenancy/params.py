@@ -1,0 +1,95 @@
+"""Per-tenant knobs as traced data: the ``TenantParams`` pytree.
+
+Multi-tenant hosting (ROADMAP item 3) puts T independent constellations
+on one device mesh through ONE compiled program — which only works if
+everything that varies per tenant is a traced leaf, never a Python
+static. The audit of SimConfig's per-run fields sorts them into two
+bins:
+
+- **already leaves** — the policy selector and every policy/market
+  hyperparameter live in ``PolicyParams`` (policies/base.py): ``idx``
+  (lax.switch selection), the promotion threshold ``max_wait_ms`` (the
+  delay zoo's l0->ready clock), the gavel/tesserae weights, and the
+  convex-market solver knobs (``mkt_iters``/``mkt_step``/``mkt_rho``/
+  ``mkt_smooth``/``mkt_sink_iters``/``mkt_sink_eps``). ``TenantParams``
+  embeds the whole struct, so a tenant axis sweeps them for free.
+- **hoisted here** — ``fault_seed`` (the generative churn stream root:
+  per-tenant failure patterns from one shared FaultConfig shape) and
+  ``quota_jobs`` (the serving tier's per-tenant admission budget; the
+  engine never reads it — it rides the pytree so the front door and the
+  bench share one provenance record of what each tenant was promised).
+
+Shape statics stay static, padded to the tenant-max: ``queue_capacity``,
+``max_nodes``, ``max_running`` and friends are array SHAPES, and a
+per-tenant shape would be a per-tenant executable — exactly what the
+one-compile contract forbids. A tenant needing a smaller queue gets the
+shared shape and a smaller ``quota_jobs``.
+
+Stacking follows ``PolicySet.stacked_params``: cells stack leaf-wise on
+a leading [T] axis and the batched drivers (tenancy/host.py) vmap over
+it — distinct values, one program, jit cache == 1 (tests/test_tenancy.py
+asserts the cache count).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from multi_cluster_simulator_tpu.config import SimConfig
+from multi_cluster_simulator_tpu.policies.base import (
+    PolicyParams, PolicySet, params_digest,
+)
+
+
+@struct.dataclass
+class TenantParams:
+    """One tenant's traced knobs (stack cells leaf-wise for a batch)."""
+
+    policy: PolicyParams  # selector + policy/market hyperparams + promotion
+    fault_seed: jax.Array  # u32 — generative churn stream root (per tenant)
+    quota_jobs: jax.Array  # i32 — serving admission budget (-1 = unmetered)
+
+
+def default_tenant_params(cfg: SimConfig, pset: Optional[PolicySet] = None,
+                          name: Optional[str] = None,
+                          policy: Optional[PolicyParams] = None,
+                          fault_seed: int = 0,
+                          quota_jobs: int = -1) -> TenantParams:
+    """A single tenant cell: the config-derived policy defaults for
+    ``name`` within ``pset`` (the singleton config set when omitted), or
+    an explicit ``policy`` pytree, plus the hoisted per-tenant leaves."""
+    if policy is None:
+        pset = PolicySet.from_config(cfg) if pset is None else pset
+        policy = pset.params_for(cfg, name)
+    return TenantParams(
+        policy=policy,
+        fault_seed=jnp.uint32(fault_seed),
+        quota_jobs=jnp.int32(quota_jobs))
+
+
+def stack_tenant_params(cells: Sequence[TenantParams]) -> TenantParams:
+    """Stack per-tenant cells on a leading [T] axis — the
+    ``PolicySet.stacked_params`` move, applied to the tenant pytree."""
+    if not cells:
+        raise ValueError("stack_tenant_params needs at least one tenant")
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *cells)
+
+
+def tenant_params_digest(tp: TenantParams) -> str:
+    """12-hex provenance digest over every tenant leaf (the
+    ``params_digest`` convention) — what bench rows record so a tenant
+    sweep is joinable with the exact knobs it ran."""
+    h = hashlib.sha1()
+    h.update(params_digest(tp.policy).encode())
+    extra = {
+        "fault_seed": jnp.asarray(tp.fault_seed).tolist(),
+        "quota_jobs": jnp.asarray(tp.quota_jobs).tolist(),
+    }
+    h.update(json.dumps(extra, sort_keys=True).encode())
+    return h.hexdigest()[:12]

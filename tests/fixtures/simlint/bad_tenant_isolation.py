@@ -1,0 +1,47 @@
+"""Fixture: cross-tenant data flow in tenancy-style code — everything the
+tenant-isolation family must flag.
+
+Five violation shapes: a whole-array reduction over a tenant-stacked leaf
+(no axis collapses the tenant axis with everything else), an explicit
+``axis=0`` reduction in module-function form, a method-form axis-0
+reduction on a name assigned from a stacking constructor (dataflow, not
+just parameter naming), a tenant-stacked leaf subscripted by an index
+derived from another stacked leaf, and a ``jnp.take`` gather whose index
+row comes from the stacked tree itself. ``TenantParams`` appears so the
+single-file convention gate engages.
+"""
+
+import jax.numpy as jnp
+
+TenantParams = object  # convention-gate token
+
+
+def billing_total(stacked_state):
+    # BAD: whole-array reduction collapses the tenant axis outside the
+    # sanctioned aggregate_* sites
+    return stacked_state.placed_total.sum()
+
+
+def noisy_neighbour_mean(stacked_state):
+    # BAD: axis=0 IS the tenant axis — a cross-tenant mean leaks every
+    # other tenant's depth into this tenant's decision
+    return jnp.mean(stacked_state.queue_depth, axis=0)
+
+
+def stack_and_reduce(cells):
+    pool = jnp.stack(cells)
+    # BAD: dataflow — `pool` came from a stacking constructor, and the
+    # method-form axis-0 max crosses tenants
+    return pool.max(axis=0)
+
+
+def cross_row_lookup(stacked_state):
+    # BAD: tenant A's queue read through an index computed from the
+    # stacked routing table (tenant B's row chooses A's data)
+    victim = stacked_state.route
+    return stacked_state.queue_ids[victim]
+
+
+def cross_row_gather(stacked_state):
+    # BAD: same leak through the take() gather form
+    return jnp.take(stacked_state.run_ids, stacked_state.route)

@@ -1,0 +1,32 @@
+"""Fixture: the paired clean form — per-lane reductions, sanctioned
+aggregate sites, and constant/loop-variable tenant indexing (the
+``tenant_cell`` idiom). Mentions ``TenantParams`` and the stacking
+constructors so the single-file convention gate engages and the pass must
+still find nothing.
+"""
+
+import jax.numpy as jnp
+
+TenantParams = object  # convention-gate token
+
+
+def per_tenant_depth(stacked_state):
+    # per-lane reduction: axis 1+ never crosses tenants
+    return stacked_state.queue_depth.sum(axis=1)
+
+
+def aggregate_placed(stacked_state):
+    # the sanctioned cross-tenant site: aggregate_* names the contract
+    return stacked_state.placed_total.sum()
+
+
+def tenant_cell_probe(stacked_state, i: int):
+    # constant / loop-variable tenant indices are the legal extraction
+    # idiom — one lane, no cross-row flow
+    return stacked_state.queue_ids[i]
+
+
+def stack_and_keep(cells):
+    pool = jnp.stack(cells)
+    # per-lane view of stacked data: the tenant axis survives intact
+    return pool.reshape(pool.shape[0], -1)

@@ -74,6 +74,7 @@ FIXTURE_RULES = [
     ("bad_env_rng.py", "env-rng"),
     ("bad_shard_exchange.py", "shard-exchange"),
     ("bad_serve_sync.py", "serve-sync"),
+    ("bad_tenant_isolation.py", "tenant-isolation"),
     ("bad_pragma.py", "pragma-no-reason"),
     ("bad_pragma.py", "pragma-stale"),
 ]
@@ -129,6 +130,9 @@ GOOD_FIXTURES = [
     ("good_obs_tap.py",
      "state reads, buffer-only writes, the buffer's own .at updates, an "
      "exchange reduction, a buffer-only host harvest"),
+    ("good_tenant_isolation.py",
+     "per-lane (axis 1+) reductions, sanctioned aggregate_* sites, "
+     "constant/loop-variable tenant indexing (the tenant_cell idiom)"),
 ]
 
 
@@ -170,6 +174,10 @@ BAD_FIXTURE_COUNTS = [
     ("bad_obs_tap.py", "obs-tap", 5,
      "state.replace store / .at[...].add into state leaf / np.asarray of "
      "traced state / float() over traced value / jax.device_get"),
+    ("bad_tenant_isolation.py", "tenant-isolation", 5,
+     "whole-array reduction / module-form axis=0 mean / method-form "
+     "axis=0 max on a stack() result / stacked leaf indexed by a "
+     "stacked-derived value / jnp.take with a stacked-derived index"),
 ]
 
 
@@ -631,6 +639,35 @@ def test_obs_tap_flags_host_coercion_in_real_tap(tmp_path):
     f = tmp_path / "device_bad_coerce.py"
     f.write_text(bad)
     assert any(x.rule == "obs-tap" for x in run(str(f)))
+
+
+def test_tenant_isolation_reaches_the_real_host_module(tmp_path):
+    """tenant-isolation provably engages with tenancy/host.py: paste a
+    cross-tenant reduction into a copy of the real stacking constructor
+    and the rule must fire — the injected-regression contract every
+    family carries (the package analyzing clean can never mean 'checked
+    nothing')."""
+    src = (PKG_DIR / "tenancy" / "host.py").read_text()
+    anchor = "    return jax.tree.map(lambda *ls: jnp.stack(ls), *cells)\n"
+    bad = src.replace(
+        anchor,
+        "    stacked_states = jax.tree.map("
+        "lambda *ls: jnp.stack(ls), *cells)\n"
+        "    _leak = stacked_states.placed_total.sum(axis=0)\n"
+        "    return stacked_states\n", 1)
+    assert bad != src, "anchor moved; update this test"
+    f = tmp_path / "host_bad.py"
+    f.write_text(bad)
+    assert any(x.rule == "tenant-isolation" for x in run(str(f)))
+
+
+def test_tenant_isolation_sanctions_the_real_aggregate_sites():
+    """The sanctioned aggregate_* helpers in tenancy/host.py cross the
+    tenant axis BY DESIGN — the family must stay silent on the real
+    module (scope engagement is proven by the injection test above)."""
+    findings = [f for f in run(str(PKG_DIR / "tenancy" / "host.py"))
+                if f.rule == "tenant-isolation"]
+    assert findings == [], "\n".join(f.render() for f in findings)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,280 @@
+"""The tenant axis (tenancy/): T independent constellations vmapped through
+ONE compiled program must be pure batching — every tenant cell bit-identical
+to its standalone single-tenant run (the envs/test_env.py oracle pattern),
+composed with the compact layout, event-compressed time, generative faults,
+and the 8-device mesh; and distinct per-tenant TenantParams must never cost
+a second compile (jit cache == 1). ARCHITECTURE.md §multi-tenant hosting,
+PARITY.md "the tenant axis is invisible to replay"."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multi_cluster_simulator_tpu import tenancy
+from multi_cluster_simulator_tpu.config import FaultConfig, SimConfig
+from multi_cluster_simulator_tpu.core import compact as CC
+from multi_cluster_simulator_tpu.core.engine import pack_arrivals_by_tick
+from multi_cluster_simulator_tpu.policies.base import PolicySet
+from multi_cluster_simulator_tpu.workload.traces import uniform_stream
+from tests.test_pipeline import _assert_trees_equal, _cfg, _specs
+
+TICK_MS = 1_000
+N_TICKS = 8
+C = 3
+
+
+def _streams(cfg, T, n_ticks=N_TICKS, seed0=7):
+    """Per-tenant bucketed streams padded to the shared tenant-max K."""
+    tas = []
+    for i in range(T):
+        arr = uniform_stream(C, 12, n_ticks * cfg.tick_ms, 24, 18_000,
+                             3 * cfg.tick_ms, seed=seed0 + i)
+        tas.append(pack_arrivals_by_tick(arr, n_ticks, cfg.tick_ms))
+    k = max(np.asarray(ta.rows).shape[2] for ta in tas)
+    return [tenancy.pad_tick_arrivals(ta, k) for ta in tas]
+
+
+def _mixed_params(tb, T):
+    """T tenants with DISTINCT traced knobs: alternating policy members of
+    one two-member set, a per-tenant promotion threshold, and distinct
+    fault seeds — the one-program-many-programs case the cache pin guards."""
+    names = tb.engine.pset.names
+    cells = []
+    for i in range(T):
+        cell = tenancy.default_tenant_params(
+            tb.cfg, pset=tb.engine.pset, name=names[i % len(names)],
+            fault_seed=i, quota_jobs=-1)
+        cell = cell.replace(policy=cell.policy.replace(
+            max_wait_ms=jnp.int32(2_000 + 1_000 * i)))
+        cells.append(cell)
+    return tenancy.stack_tenant_params(cells)
+
+
+def _cell_states(tb, tp, T):
+    """Standalone per-tenant runs: the oracle each stacked cell must match
+    bit-for-bit (one shared engine, so params stay the only variable)."""
+    solo = tb.engine.run_io_jit(donate=False)
+    tas = _streams(tb.cfg, T)
+    outs = []
+    for i in range(T):
+        cell = tenancy.tenant_cell(tp, i)
+        s0 = tenancy.init_tenant_state(tb.cfg, tb.specs, cell, plan=tb.plan)
+        outs.append(solo(s0, tas[i].rows, tas[i].counts,
+                         params=cell.policy)[0])
+    return outs, tas
+
+
+# --------------------------------------------------------------------------
+# parity pins
+# --------------------------------------------------------------------------
+
+def test_t1_bit_identical_to_run_jit():
+    """One tenant through the batched driver is the engine: T=1 vmapped
+    run over a stacked stream == Engine.run_jit over the plain stream."""
+    cfg = _cfg()
+    specs = _specs(C)
+    tb = tenancy.TenantBatch(cfg, specs)
+    tp = tb.default_params(1)
+    ta = _streams(cfg, 1)[0]
+    sta = tenancy.stack_tick_arrivals([ta])
+
+    out = tb.run_fn(N_TICKS, donate=False)(tb.init_stacked(tp), sta, tp)
+
+    ref = tb.engine.run_jit(donate=False)(
+        tenancy.init_tenant_state(cfg, specs, tenancy.tenant_cell(tp, 0)),
+        ta, N_TICKS, params=tenancy.tenant_cell(tp, 0).policy)
+    _assert_trees_equal(ref, tenancy.tenant_cell(out, 0))
+
+
+def test_cells_bit_identical_to_standalone_and_one_compile():
+    """Every cell of a T=4 mixed-policy batch equals its standalone run;
+    distinct TenantParams leaves (policy member, promotion threshold,
+    fault seed) share ONE executable."""
+    cfg = _cfg()
+    specs = _specs(C)
+    tb = tenancy.TenantBatch(cfg, specs,
+                             policies=PolicySet(("fifo", "delay")))
+    T = 4
+    tp = _mixed_params(tb, T)
+    refs, tas = _cell_states(tb, tp, T)
+
+    fn = tb.run_io_fn(donate=False)
+    sta = tenancy.stack_tick_arrivals(tas)
+    out, _io = fn(tb.init_stacked(tp), sta.rows, sta.counts, tp)
+    for i in range(T):
+        _assert_trees_equal(refs[i], tenancy.tenant_cell(out, i))
+    assert fn._jit._cache_size() == 1, "tenant knobs are data, not programs"
+
+    # a SECOND batch with different leaf values must hit the same cache
+    tp2 = jax.tree.map(lambda a: a, tp).replace(
+        policy=tp.policy.replace(max_wait_ms=tp.policy.max_wait_ms + 500))
+    fn(tb.init_stacked(tp2), sta.rows, sta.counts, tp2)
+    assert fn._jit._cache_size() == 1
+
+
+def test_compact_plan_composes():
+    """The tenant axis over the compact SoA layout: per-cell parity holds
+    with a derived narrowing plan threaded through init + dispatch."""
+    cfg = _cfg()
+    specs = _specs(C)
+    arr = uniform_stream(C, 12, N_TICKS * cfg.tick_ms, 24, 18_000,
+                         3 * cfg.tick_ms, seed=7)
+    plan = CC.derive_plan(cfg, specs, arr)
+    tb = tenancy.TenantBatch(cfg, specs, plan=plan)
+    T = 3
+    tp = tb.default_params(T)
+    refs, tas = _cell_states(tb, tp, T)
+
+    sta = tenancy.stack_tick_arrivals(tas)
+    out, _io = tb.run_io_fn(donate=False)(
+        tb.init_stacked(tp), sta.rows, sta.counts, tp)
+    for i in range(T):
+        _assert_trees_equal(refs[i], tenancy.tenant_cell(out, i))
+
+
+def test_compressed_driver_composes():
+    """Event-compressed virtual time under the tenant vmap: each lane
+    leaps its own quiescent gaps, bit-identical to the standalone
+    compressed run (a leaping tenant never perturbs a dense one)."""
+    cfg = _cfg()
+    specs = _specs(C)
+    tb = tenancy.TenantBatch(cfg, specs)
+    T = 3
+    tp = tb.default_params(T)
+    tas = _streams(cfg, T)
+
+    def solo(i):
+        cell = tenancy.tenant_cell(tp, i)
+        s0 = tenancy.init_tenant_state(cfg, specs, cell)
+        out = tb.engine.run_compressed(s0, tas[i], N_TICKS,
+                                       params=cell.policy)
+        return out[0] if isinstance(out, tuple) else out
+
+    sta = tenancy.stack_tick_arrivals(tas)
+    out = tb.run_compressed_fn(N_TICKS, donate=False)(
+        tb.init_stacked(tp), sta, tp)
+    for i in range(T):
+        _assert_trees_equal(solo(i), tenancy.tenant_cell(out, i))
+
+
+def test_generative_faults_per_tenant_streams():
+    """Distinct fault seeds give each tenant its own churn pattern from
+    one shared FaultConfig shape — and every faulted cell still equals
+    its standalone run (the reseed happens at init, so the traced program
+    is seed-free)."""
+    cfg = _cfg(faults=FaultConfig(enabled=True, mode="generative",
+                                  mttf_ms=4_000, mttr_ms=2_000, seed=3))
+    specs = _specs(C)
+    tb = tenancy.TenantBatch(cfg, specs)
+    T = 3
+    tp = tb.default_params(T)  # fault seeds 0, 1, 2
+    refs, tas = _cell_states(tb, tp, T)
+
+    sta = tenancy.stack_tick_arrivals(tas)
+    out, _io = tb.run_io_fn(donate=False)(
+        tb.init_stacked(tp), sta.rows, sta.counts, tp)
+    for i in range(T):
+        _assert_trees_equal(refs[i], tenancy.tenant_cell(out, i))
+
+    # distinct seeds must actually distinguish the churn: at this MTTF
+    # (4 ticks) identical fault timelines across tenants would mean the
+    # seed leaf is dead
+    f01 = [np.array_equal(np.asarray(a), np.asarray(b))
+           for a, b in zip(jax.tree.leaves(tenancy.tenant_cell(out, 0)),
+                           jax.tree.leaves(tenancy.tenant_cell(out, 1)))]
+    assert not all(f01), "tenants 0/1 ran identical fault timelines"
+
+
+def test_mesh_sharded_bit_identical():
+    """Pytree-prefix placement over the 8-device mesh: tenants are
+    independent, so data-parallel jit needs no collectives and the
+    sharded batch is bitwise the unsharded batch."""
+    cfg = _cfg()
+    specs = _specs(C)
+    tb = tenancy.TenantBatch(cfg, specs)
+    T = 8
+    tp = tb.default_params(T)
+    tas = _streams(cfg, T)
+    sta = tenancy.stack_tick_arrivals(tas)
+    fn = tb.run_io_fn(donate=False)
+    ref, _ = fn(tb.init_stacked(tp), sta.rows, sta.counts, tp)
+
+    from multi_cluster_simulator_tpu.parallel import make_mesh
+    mesh = make_mesh(8, axis="tenants")
+    s0 = tenancy.shard_tenant_batch(tb.init_stacked(tp), mesh)
+    rows = tenancy.shard_tenant_batch(sta.rows, mesh)
+    counts = tenancy.shard_tenant_batch(sta.counts, mesh)
+    stp = tenancy.shard_tenant_batch(tp, mesh)
+    out, _ = fn(s0, rows, counts, stp)
+    _assert_trees_equal(ref, out)
+
+
+def test_shard_divisibility_error_names_valid_counts():
+    from multi_cluster_simulator_tpu.parallel import make_mesh
+    cfg = _cfg()
+    tb = tenancy.TenantBatch(cfg, _specs(C))
+    tp = tb.default_params(3)
+    mesh = make_mesh(8, axis="tenants")
+    with pytest.raises(ValueError, match="nearest valid tenant counts"):
+        tenancy.shard_tenant_batch(tb.init_stacked(tp), mesh)
+
+
+# --------------------------------------------------------------------------
+# plumbing
+# --------------------------------------------------------------------------
+
+def test_stack_tick_arrivals_rejects_ragged_k():
+    cfg = _cfg()
+    tas = _streams(cfg, 2)
+    narrow = jax.tree.map(lambda a: a, tas[0])
+    narrow = type(narrow)(rows=np.asarray(narrow.rows)[:, :, :1],
+                          counts=np.minimum(np.asarray(narrow.counts), 1))
+    with pytest.raises(ValueError, match="pad K to the tenant-max"):
+        tenancy.stack_tick_arrivals([narrow, tas[1]])
+
+
+def test_pad_tick_arrivals_is_semantically_invisible():
+    """Widening K with invalid rows must not change the run (ingest only
+    consumes each tick's [0, count) prefix)."""
+    cfg = _cfg()
+    specs = _specs(C)
+    tb = tenancy.TenantBatch(cfg, specs)
+    tp = tb.default_params(1)
+    ta = _streams(cfg, 1)[0]
+    wide = tenancy.pad_tick_arrivals(ta, np.asarray(ta.rows).shape[2] + 5)
+    cell = tenancy.tenant_cell(tp, 0)
+    solo = tb.engine.run_io_jit(donate=False)
+    s_ref = solo(tenancy.init_tenant_state(cfg, specs, cell),
+                 ta.rows, ta.counts, params=cell.policy)[0]
+    s_wide = solo(tenancy.init_tenant_state(cfg, specs, cell),
+                  wide.rows, wide.counts, params=cell.policy)[0]
+    _assert_trees_equal(s_ref, s_wide)
+
+
+def test_tenant_params_digest_tracks_every_leaf():
+    cfg = _cfg()
+    a = tenancy.default_tenant_params(cfg, fault_seed=0)
+    b = tenancy.default_tenant_params(cfg, fault_seed=1)
+    c = tenancy.default_tenant_params(cfg, quota_jobs=64)
+    d = a.replace(policy=a.policy.replace(max_wait_ms=jnp.int32(123)))
+    digests = {tenancy.tenant_params_digest(x) for x in (a, b, c, d)}
+    assert len(digests) == 4
+    assert tenancy.tenant_params_digest(a) == tenancy.tenant_params_digest(
+        tenancy.default_tenant_params(cfg, fault_seed=0))
+
+
+def test_aggregate_sites_sum_over_tenants():
+    cfg = _cfg()
+    specs = _specs(C)
+    tb = tenancy.TenantBatch(cfg, specs)
+    T = 3
+    tp = tb.default_params(T)
+    tas = _streams(cfg, T)
+    sta = tenancy.stack_tick_arrivals(tas)
+    out, _io = tb.run_io_fn(donate=False)(
+        tb.init_stacked(tp), sta.rows, sta.counts, tp)
+    per_cell = sum(int(np.sum(np.asarray(
+        tenancy.tenant_cell(out, i).placed_total))) for i in range(T))
+    assert tenancy.aggregate_placed(out) == per_cell > 0
+    assert all(v == 0 for v in tenancy.aggregate_drops(out).values())

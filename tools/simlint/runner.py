@@ -8,7 +8,7 @@ from typing import Iterable, Optional
 from tools.simlint import (
     compactstore, determinism, envrng, findings as F, lockset, obstap,
     pallaskernel, policykernel, purity, servesync, shardexchange,
-    solverkernel,
+    solverkernel, tenantisolation,
 )
 from tools.simlint.callgraph import CallGraph
 from tools.simlint.project import Module, in_scope, load_target
@@ -46,8 +46,15 @@ ENV_RNG_RULES = ("env-rng",)
 # itself (exchange.py/multihost.py are the sanctioned modules, excluded
 # inside the pass)
 SHARD_EXCHANGE_DIRS = ("core", "ops", "market", "envs", "policies",
-                       "workload", "parallel", "obs")
+                       "workload", "parallel", "obs", "tenancy")
 SHARD_EXCHANGE_RULES = ("shard-exchange",)
+# tenant isolation (ISSUE 18): in tenancy/ scope, no reduction may cross
+# the tenant axis outside the sanctioned aggregate_* helpers, and no
+# tenant-stacked leaf may be indexed by a value derived from another
+# tenant's row — the machine check behind "the tenant axis is invisible
+# to replay" (PARITY.md)
+TENANT_ISOLATION_DIRS = ("tenancy",)
+TENANT_ISOLATION_RULES = ("tenant-isolation",)
 # the device metrics plane (ISSUE 12): taps in obs/ may only READ
 # SimState leaves (never store into sim state) and may not host-coerce
 # inside jit scope — the bit-invisibility contract, machine-checked
@@ -77,7 +84,7 @@ ALL_RULES = (PURITY_RULES + LOCKSET_RULES + DET_RULES + COMPACT_RULES
              + POLICY_KERNEL_RULES + PALLAS_KERNEL_RULES
              + SOLVER_KERNEL_RULES + ENV_RNG_RULES
              + SHARD_EXCHANGE_RULES + SERVE_SYNC_RULES + OBS_TAP_RULES
-             + PRAGMA_RULES)
+             + TENANT_ISOLATION_RULES + PRAGMA_RULES)
 
 
 def run(target: str, rules: Optional[Iterable[str]] = None,
@@ -135,6 +142,11 @@ def run(target: str, rules: Optional[Iterable[str]] = None,
                 mod.relpath != "" or obstap.module_is_tap(mod)):
             raw += obstap.check_module(mod)
             checked.update(OBS_TAP_RULES)
+        if in_scope(mod, TENANT_ISOLATION_DIRS) and (
+                mod.relpath != ""
+                or tenantisolation.module_is_tenancy(mod)):
+            raw += tenantisolation.check_module(mod)
+            checked.update(TENANT_ISOLATION_RULES)
 
     if selected is not None:
         raw = [f for f in raw if f.rule in selected]

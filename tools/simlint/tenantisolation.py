@@ -1,0 +1,213 @@
+"""tenant-isolation pass: tenants never read each other's rows.
+
+The multi-tenant hosting contract (tenancy/host.py, ARCHITECTURE.md
+§multi-tenant hosting): a tenant-stacked pytree carries T independent
+constellations on a leading [T] axis, and vmap-of-a-pure-function keeps
+every lane bit-identical to its standalone run — the property the bench's
+sampled-cell parity gate and PARITY.md's "the tenant axis is invisible to
+replay" clause both pin. ONE stray reduction over the tenant axis, or one
+lookup of tenant A's leaf through an index computed from tenant B's row,
+silently couples tenants: billing leaks, noisy neighbours, and a parity
+break only the full T-way cell probe would catch. So the discipline is
+machine-checked at the AST, like the rest of the rule families.
+
+**Tenant-stacked roots** are tracked by convention + dataflow: parameters
+and variables named ``stacked*`` / ``stacked_state``, and names assigned
+from the stacking constructors (``stack_tenant_states``,
+``stack_tenant_params``, ``stack_tick_arrivals``, ``init_stacked``,
+``jnp.stack``). Attribute/subscript chains keep their root (``
+stacked.queue_ids`` is stacked data). Inside ``tenancy/`` scope the pass
+flags:
+
+- **cross-tenant reductions outside sanctioned aggregate sites** — a
+  whole-array or ``axis=0`` reduction (``sum/mean/max/min/prod/any/all``,
+  function or method form) over a tenant-stacked root anywhere except a
+  function named ``aggregate_*``: axis 0 IS the tenant axis by contract,
+  and the ``aggregate_*`` helpers in tenancy/host.py are the only places
+  a number may cross it;
+- **cross-tenant traced indexing** — subscripting a tenant-stacked root
+  (or ``jnp.take`` / ``.take`` over one) with an index expression that is
+  itself derived from tenant-stacked data: ``stacked_q[stacked.route]``
+  reads tenant A's queue through tenant B's routing row. Constant and
+  loop-variable indices (``tenant_cell``'s per-lane extraction) are the
+  legal idiom and stay silent.
+
+Standalone-file targets engage this family when the file looks like
+tenancy code (``module_is_tenancy``), the single-file convention gate the
+other scoped families use.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.simlint.findings import Finding
+from tools.simlint.project import Module
+
+RULE = "tenant-isolation"
+
+_REDUCERS = frozenset({"sum", "mean", "max", "min", "prod", "any", "all"})
+_STACK_CTORS = frozenset({"stack_tenant_states", "stack_tenant_params",
+                          "stack_tick_arrivals", "stack", "init_stacked"})
+_SANCTIONED_PREFIX = "aggregate_"
+
+
+def module_is_tenancy(mod: Module) -> bool:
+    """Single-file convention gate: engage for files that carry tenant-
+    batch code (the TenantParams type or the stacking constructors)."""
+    return "TenantParams" in mod.source or "stack_tenant" in mod.source
+
+
+def _root_name(node) -> str:
+    """The leftmost Name of an attribute/subscript chain
+    (``stacked.queue_ids[0]`` -> ``stacked``)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _is_stacked_name(name: str, stacked: set[str]) -> bool:
+    return name in stacked or name.startswith("stacked")
+
+
+def _expr_touches_stacked(node, stacked: set[str]) -> bool:
+    """Does any Name inside ``node`` resolve to tenant-stacked data?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and _is_stacked_name(n.id, stacked):
+            return True
+    return False
+
+
+def _call_tail(call: ast.Call) -> str:
+    """The called function's final attribute / bare name."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _collect_stacked(fn, stacked: set[str]) -> None:
+    """Dataflow: names assigned from the stacking constructors join the
+    stacked set (``out = stack_tenant_states(cells)``; aliases of an
+    existing stacked name propagate)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        v = node.value
+        if isinstance(v, ast.Call) and _call_tail(v) in _STACK_CTORS:
+            stacked.add(tgt.id)
+        elif isinstance(v, (ast.Name, ast.Attribute, ast.Subscript)) \
+                and _is_stacked_name(_root_name(v), stacked) \
+                and not isinstance(v, ast.Subscript):
+            # plain alias / attribute projection keeps the root; a
+            # subscript extracts ONE tenant's cell and leaves the set
+            stacked.add(tgt.id)
+
+
+def _reduction_axis0(call: ast.Call) -> bool:
+    """axis=0 explicitly names the tenant axis; a reduction with NO axis
+    collapses it too (whole-array)."""
+    for kw in call.keywords:
+        if kw.arg == "axis":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value == 0)
+    # positional axis (np.sum(x, 0)) or no axis at all
+    if len(call.args) >= 2:
+        a = call.args[1]
+        return isinstance(a, ast.Constant) and a.value == 0
+    return True
+
+
+def check_module(mod: Module) -> list[Finding]:
+    out: list[Finding] = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name.startswith(_SANCTIONED_PREFIX):
+            continue  # the sanctioned cross-tenant aggregate sites
+        stacked: set[str] = set()
+        for a in (fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs):
+            if _is_stacked_name(a.arg, stacked):
+                stacked.add(a.arg)
+        # the naming convention seeds the set too: a ``stacked*`` local
+        # is stacked data wherever it came from (jax.tree.map stacking
+        # lambdas hide the jnp.stack call from the ctor dataflow)
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and n.id.startswith("stacked"):
+                stacked.add(n.id)
+        _collect_stacked(fn, stacked)
+        if not stacked:
+            continue
+        for node in ast.walk(fn):
+            # --- cross-tenant reductions ------------------------------
+            if isinstance(node, ast.Call):
+                tail = _call_tail(node)
+                f = node.func
+                if tail in _REDUCERS and isinstance(f, ast.Attribute):
+                    # method form stacked.x.sum(...) OR module form
+                    # jnp.sum(stacked.x, ...)
+                    if _is_stacked_name(_root_name(f.value), stacked):
+                        if _reduction_axis0(node):
+                            out.append(Finding(
+                                mod.path, node.lineno, RULE,
+                                f"cross-tenant reduction `.{tail}()` over "
+                                "a tenant-stacked value outside the "
+                                "sanctioned aggregate_* sites — axis 0 is "
+                                "the tenant axis; per-tenant code reduces "
+                                "per-lane (axis >= 1) and cross-tenant "
+                                "totals live in tenancy/host.py's "
+                                "aggregate helpers"))
+                            continue
+                    elif node.args and _is_stacked_name(
+                            _root_name(node.args[0]), stacked) \
+                            and _reduction_axis0(node):
+                        out.append(Finding(
+                            mod.path, node.lineno, RULE,
+                            f"cross-tenant reduction `{tail}(...)` over a "
+                            "tenant-stacked value outside the sanctioned "
+                            "aggregate_* sites — axis 0 is the tenant "
+                            "axis; route cross-tenant totals through "
+                            "tenancy/host.py's aggregate helpers"))
+                        continue
+                # --- traced cross-tenant gather (jnp.take form) -------
+                if tail == "take":
+                    base_stacked = False
+                    idx = None
+                    if isinstance(f, ast.Attribute) and _is_stacked_name(
+                            _root_name(f.value), stacked):
+                        base_stacked = True  # stacked.x.take(idx)
+                        idx = node.args[0] if node.args else None
+                    elif len(node.args) >= 2 and _is_stacked_name(
+                            _root_name(node.args[0]), stacked):
+                        base_stacked = True  # jnp.take(stacked.x, idx)
+                        idx = node.args[1]
+                    if base_stacked and idx is not None \
+                            and _expr_touches_stacked(idx, stacked):
+                        out.append(Finding(
+                            mod.path, node.lineno, RULE,
+                            "cross-tenant traced gather: `take` over a "
+                            "tenant-stacked value with an index derived "
+                            "from tenant-stacked data — tenant A's leaf "
+                            "read through tenant B's row breaks the "
+                            "cell-parity contract (the tenant axis must "
+                            "stay invisible to replay)"))
+                        continue
+            # --- cross-tenant traced indexing -------------------------
+            if isinstance(node, ast.Subscript) and _is_stacked_name(
+                    _root_name(node.value), stacked):
+                if _expr_touches_stacked(node.slice, stacked):
+                    out.append(Finding(
+                        mod.path, node.lineno, RULE,
+                        "cross-tenant traced indexing: a tenant-stacked "
+                        "leaf subscripted by a value derived from "
+                        "tenant-stacked data — per-lane code sees only "
+                        "its own row (constant / loop-variable tenant "
+                        "indices are the legal tenant_cell idiom)"))
+    out.sort(key=lambda x: (x.line, x.message))
+    return out

@@ -226,6 +226,40 @@ def _build_serving_dispatch():
                  pick_state_out=lambda o: o[0])
 
 
+def _build_tenancy_run_io():
+    # the multi-tenant hosting dispatch (tenancy/host.py): ONE vmapped
+    # run_io executable across T tenant cells with DISTINCT TenantParams
+    # leaves. The retrace audit IS the jit-cache==1 contract across
+    # tenants — every variant re-stacks different fault seeds and policy
+    # knobs, so any recompile means a per-tenant knob leaked into the
+    # statics (the one-program-many-tenants invariant the tenant bench
+    # asserts at T=256, audited here at CI shape)
+    import jax.numpy as jnp
+
+    from multi_cluster_simulator_tpu import tenancy
+    n, tt = 2, 3  # clusters per tenant, resident tenants
+    cfg, specs = _quick_cfg(), _specs(n)
+    tb = tenancy.TenantBatch(cfg, specs)
+    rio = tb.run_io_fn(donate=True)
+
+    def fresh(v):
+        cells = []
+        for i in range(tt):
+            cell = tenancy.default_tenant_params(
+                cfg, pset=tb.engine.pset, fault_seed=v * 100 + i)
+            cells.append(cell.replace(policy=cell.policy.replace(
+                max_wait_ms=jnp.int32(1_000 + 500 * i + v))))
+        tp = tenancy.stack_tenant_params(cells)
+        state = tb.init_stacked(tp)
+        tas = [_ticks(v * tt + i, n, cfg=cfg) for i in range(tt)]
+        rows = np.stack([np.asarray(ta.rows)[:4] for ta in tas])
+        counts = np.stack([np.asarray(ta.counts)[:4] for ta in tas])
+        return (state, rows, counts, tp)
+
+    return Built(fn=rio._jit, fresh_args=fresh, donated=(0,),
+                 pick_state_out=lambda o: o[0])
+
+
 ENTRIES = [
     EntryPoint("engine.run", _build_run,
                description=f"run_jit(donate) C={C} T={T} K<={KPAD} compact"),
@@ -243,4 +277,7 @@ ENTRIES = [
                description=f"batch_step_fn(donate) C={C} B=3 ep={T}"),
     EntryPoint("serving.dispatch", _build_serving_dispatch,
                description="run_io_jit(donate)+metrics C=2 T=4"),
+    EntryPoint("tenancy.run_io", _build_tenancy_run_io,
+               description="vmap run_io_fn(donate) tenants=3 C=2 T=4 "
+                           "distinct TenantParams, cache==1"),
 ]
