@@ -122,13 +122,14 @@ def _leapable(counts) -> bool:
 _OBS = {"mode": "off", "max_overhead": 0.03}
 
 # The fused tick kernel (kernels/fused_tick.py), set by main() from
-# --fused. "off" keeps the unfused XLA tick; "on" runs the ingest->schedule
-# span as ONE pallas_call per cluster block (interpret mode on non-TPU
-# backends — the CPU/CI oracle); "auto" engages only on a real TPU
-# backend; "ab" runs fused as the primary measurement, re-runs unfused,
-# and GATES: final states bitwise identical (state digests compared) and
-# the fused span's buffer-boundary bytes strictly below the per-phase
-# unfused executables' (the collapse the kernel exists for).
+# --fused. "off" keeps the unfused XLA tick; "on" runs the per-cluster
+# prefix (the config's engaged span of faults->schedule) as ONE
+# pallas_call per cluster block (interpret mode on non-TPU backends —
+# the CPU/CI oracle); "auto" engages only on a real TPU backend; "ab"
+# runs fused as the primary measurement, re-runs unfused, and GATES:
+# final states bitwise identical (state digests compared) and the fused
+# prefix's buffer-boundary bytes strictly below the per-phase unfused
+# executables' (the collapse the kernel exists for).
 _FUSED = {"mode": "off", "ab": False}
 
 # persistent-compilation-cache state, set by _setup_jax() so details can
@@ -3257,9 +3258,10 @@ def main():
                          "mesh) is bit-identical")
     ap.add_argument("--fused", choices=("off", "on", "auto", "ab"),
                     default="off",
-                    help="the fused ingest->schedule tick kernel "
-                         "(kernels/fused_tick.py): one pallas_call keeps "
-                         "each cluster block's queue/runset/node columns "
+                    help="the fused per-cluster tick prefix "
+                         "(kernels/fused_tick.py, phases faults->"
+                         "schedule): one pallas_call keeps each cluster "
+                         "block's queue/runset/node columns "
                          "in VMEM across the span (interpret-mode oracle "
                          "on non-TPU backends). auto engages only on a "
                          "real TPU; ab runs fused then unfused and FAILS "

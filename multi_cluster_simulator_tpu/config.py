@@ -217,10 +217,12 @@ class SimConfig:
     # digest (core/checkpoint.config_describe) — a run may be checkpointed
     # unfused and resumed fused, or vice versa.
     #   "off"  — the unfused XLA tick (default; every pre-kernel path)
-    #   "on"   — always run the ingest->schedule span as ONE pallas_call
-    #            that keeps the block's queue/runset/node columns in VMEM
-    #            across the phase boundary (interpret-mode on non-TPU
-    #            backends unless fused_interpret pins it)
+    #   "on"   — always run the per-cluster prefix (the engaged span of
+    #            faults->release->expire->ingest->schedule) as ONE
+    #            pallas_call that keeps the block's queue/runset/node
+    #            columns in VMEM across the phase boundaries
+    #            (interpret-mode on non-TPU backends unless
+    #            fused_interpret pins it)
     #   "auto" — fuse only where it pays: a real TPU backend (interpret
     #            mode is an oracle, not a fast path — CPU stays unfused)
     fused: str = "off"

@@ -651,7 +651,7 @@ class Engine:
             self._trade_round = None
 
     def fused_active(self) -> bool:
-        """Does this engine run the ingest->schedule span as the Pallas
+        """Does this engine run the per-cluster prefix as the Pallas
         kernel? ``off`` never, ``on`` always (interpret-mode on non-TPU
         backends — the CPU/CI oracle), ``auto`` only where it pays: a real
         TPU backend (kernels.fused_tick.is_active is the one definition)."""
@@ -665,6 +665,26 @@ class Engine:
         produced it."""
         from multi_cluster_simulator_tpu.kernels import fused_tick
         return fused_tick.provenance(self.cfg)
+
+    def prefix_phases(self) -> tuple[str, ...]:
+        """The tick phases THIS config's per-cluster prefix engages, in
+        obs.profile.TICK_PHASES order — the span the fused kernel replays
+        (kernels.fused_tick.engaged_span is the one definition; faults and
+        vnode expiry are config-gated Python branches, so a faults-off
+        config fuses a shorter prefix rather than paying dead phases)."""
+        from multi_cluster_simulator_tpu.kernels import fused_tick
+        return fused_tick.engaged_span(self.cfg)
+
+    def prefix_terminal(self) -> bool:
+        """Does the tick END with the per-cluster prefix? True when every
+        post-span phase is structurally off: no return delivery or borrow
+        matching (``cfg.borrowing``) and no trader snapshot/trade round.
+        When terminal, the checked exit-narrow and the obs metrics tap
+        fold into the span (the fused kernel's epilogue) — the span-end
+        state IS the post-tick state, so the folded tap reads exactly
+        what the post-tick tap would."""
+        return (not self.cfg.borrowing and not self.cfg.trader.enabled
+                and self._trade_round is None)
 
     def _span_ingest_schedule(self, state: SimState, arr_rows, arr_n, t,
                               params, tick_indexed: bool,
@@ -752,7 +772,7 @@ class Engine:
 
     def tick_io(self, state: SimState, arrivals: Arrivals) -> tuple[SimState, TickIO]:
         """One tick, also returning the host-visible TickIO events."""
-        return self._tick(state, pack_arrivals(arrivals), emit_io=True)
+        return self._tick(state, pack_arrivals(arrivals), emit_io=True)[:2]
 
     def step_tick(self, state: SimState, rows: jax.Array, counts: jax.Array,
                   params=None) -> SimState:
@@ -767,37 +787,46 @@ class Engine:
         return self._tick(state, (rows, counts), emit_io=False,
                           tick_indexed=True, params=params)[0]
 
-    def _tick(self, state: SimState, packed_arrivals, emit_io: bool,
-              tick_indexed: bool = False, params=None, phase_limit=None):
-        """The tick body. ``emit_io=False`` (the batch/scan path) skips the
-        TickIO packing work when borrowing doesn't need it — the return-slot
-        argsort is per-tick cost the headline config shouldn't pay.
-        ``tick_indexed``: ``packed_arrivals`` is this tick's
-        (rows [C, K, NF], counts [C]) TickArrivals slice instead of the
-        whole stream. ``params``: the PolicyParams pytree selecting and
-        parameterizing the scheduling pass (None = this engine's
-        config-derived defaults, baked as constants). ``phase_limit``:
-        static int truncating the body after the first N phases
-        (obs.profile.TICK_PHASES order) — the profile plane's ablation
-        hook (``run_prefix``/tools/profile_capture.py); None runs all
-        phases (obs.profile.TICK_PHASES has the authoritative count).
-        Every phase is wrapped in a ``jax.named_scope`` so profiler
-        captures attribute device time per phase (trace-time metadata
-        only — bitwise invisible to the compiled program's results)."""
-        cfg = self.cfg
-        if params is None:
-            params = self._default_params
-        phase_on = (lambda k: True) if phase_limit is None else \
-            (lambda k: k <= phase_limit)
-        t = state.t + cfg.tick_ms
+    def _span_prefix(self, state: SimState, arr_rows, arr_n, t, params,
+                     tick_indexed: bool, emit_returns: bool, obs=None,
+                     phase_limit=None, only_phase=None):
+        """Phases 1–5 — the per-cluster-local PREFIX of the tick: faults →
+        completions/returns-pack → vnode expiry → ingest → schedule. Every
+        op in here is per-cluster (vmapped over the cluster axis), which is
+        what makes the whole prefix blockable: with ``cfg.fused`` the
+        kernel body replays THIS function on block-resident values
+        (kernels/fused_tick.py), so fused == unfused is equality of the
+        same code. The first cross-cluster exchange — return delivery,
+        borrow matching, snapshot, trade — stays in ``_tick``; the
+        prefix's outputs are exactly what those phases consume.
 
-        # compact node storage: widen ONCE at tick entry so every phase
-        # (placement compares, occupy/release arithmetic, market carves)
-        # computes in int32 exactly as the wide layout does; the exit
-        # narrow below restores the storage dtype. checked=False by the
-        # conservation invariant: free stays in [0, cap] (utils/trace.
-        # check_conservation) and cap is bounded by the plan's audit —
-        # nothing fresh enters the system here.
+        ``emit_returns``: pack the finished-foreign-job return rows
+        (needed by borrowing's delivery or an ``emit_io`` tick); when off,
+        ``ret_rows``/``ret_valid`` return as None so the fused path
+        carries no dead outputs. ``obs``: an optional ``(pc, cursor)``
+        pair (obs.device.tap_pc form) engaging the metrics tap as the
+        span EPILOGUE — legal only when ``prefix_terminal()`` (the
+        span-end state is the post-tick state). ``phase_limit`` truncates
+        as in ``_tick``; ``only_phase`` (static int, exclusive with
+        ``phase_limit``) runs exactly ONE phase — the boundary-bytes
+        probe's per-phase-executable hook (kernels.span_boundary_bytes),
+        never a simulation path.
+
+        Compact node storage: widened ONCE at span entry so every phase
+        computes in int32 exactly as the wide layout does (checked=False
+        by the conservation invariant: free stays in [0, cap] and cap is
+        bounded by the plan's audit — nothing fresh enters here). When
+        the prefix is terminal the CHECKED exit narrow folds in too, so
+        the fused kernel loads AND stores the narrow columns.
+
+        Returns ``(state, want, bjob_vec, ret_rows, ret_valid, obs_out)``
+        with ``obs_out = (pc', cursor', placed_d, depth)`` or None."""
+        cfg = self.cfg
+        if only_phase is not None:
+            phase_on = lambda k: k == only_phase  # noqa: E731
+        else:
+            phase_on = (lambda k: True) if phase_limit is None else \
+                (lambda k: k <= phase_limit)
         node_dt = state.node_free.dtype
         node_narrow = node_dt != jnp.int32
         if node_narrow:
@@ -827,7 +856,12 @@ class Engine:
                         flag, lambda s_: run_faults(s_, True),
                         lambda s_: run_faults(s_, False), state)
 
-        # 2. completions (+ returns of finished foreign jobs)
+        # 2. completions + the returns PACK (per-cluster argsort). The
+        # cross-cluster half — delivering the packed rows to their owners
+        # — happens in ``_tick`` after the prefix; the reorder is bitwise
+        # free because delivery touches ONLY ``state.borrowed`` and no
+        # prefix phase reads or writes it (expire: node columns; ingest:
+        # arrival queues; schedule: queues/runset/nodes).
         with phase_scope("release"):
             if phase_on(2):
                 run_before = state.run
@@ -835,19 +869,13 @@ class Engine:
                                      in_axes=(_STATE_AXES, None),
                                      out_axes=(_STATE_AXES, 0))(state, t)
                 state = st2
-            else:
-                done = jnp.zeros(state.run.active.shape, bool)
-            if phase_on(2) and (cfg.borrowing or emit_io):
+            if phase_on(2) and emit_returns:
                 ret_rows, ret_valid, ret_dropped = _pack_returns(
                     run_before, done, cfg.max_msgs)
                 state = state.replace(drops=state.drops.replace(
                     msgs=state.drops.msgs + ret_dropped))
             else:
-                C = done.shape[0]
-                ret_rows = jnp.zeros((C, cfg.max_msgs, R.RF), jnp.int32)
-                ret_valid = jnp.zeros((C, cfg.max_msgs), bool)
-            if phase_on(2) and cfg.borrowing:
-                state = _deliver_returns(state, ret_rows, ret_valid, self.ex)
+                ret_rows, ret_valid = None, None
 
         # 3. virtual-node expiry (off in parity mode — reference keeps them)
         if cfg.trader.enabled and cfg.trader.expire_virtual_nodes \
@@ -857,27 +885,124 @@ class Engine:
                                  in_axes=(_STATE_AXES, None),
                                  out_axes=_STATE_AXES)(state, t)
 
-        # 4+5. the ingest -> schedule span. The two phases are contiguous
-        # and per-cluster-local (the profile plane ranks the schedule pass
-        # the dominant tick cost — tools/profile_capture.py), so with
-        # ``cfg.fused`` they run as ONE Pallas kernel that loads each
-        # cluster block's queue/runset/node columns once, executes the span
-        # over them in VMEM, and writes each column back once
-        # (kernels/fused_tick.py). Bit-identical by construction: the
-        # kernel body executes ``_span_ingest_schedule`` itself on the
-        # block-resident values — same ops, same order, any state layout.
-        # ``run_prefix`` truncations inside the span fall back to the
-        # unfused path (a half-span is a diagnostic, not a kernel).
+        # 4+5. the ingest -> schedule span
+        state, want, bjob_vec = self._span_ingest_schedule(
+            state, arr_rows, arr_n, t, params, tick_indexed,
+            do_ingest=phase_on(4), do_schedule=phase_on(5))
+
+        if node_narrow and self.prefix_terminal():
+            # CHECKED narrow (see _tick's exit narrow for the rationale);
+            # folded into the span when nothing runs after it
+            free_n, bad_f = F.narrow_store(state.node_free, node_dt)
+            cap_n, bad_c = F.narrow_store(state.node_cap, node_dt)
+            state = state.replace(
+                node_free=free_n, node_cap=cap_n,
+                run=state.run.replace(ovf=state.run.ovf + bad_f + bad_c))
+
+        obs_out = None
+        if obs is not None:
+            if not self.prefix_terminal():
+                raise ValueError(
+                    "epilogue tap requested on a non-terminal prefix — "
+                    "post-span phases would move the counters after the "
+                    "tap (obs belongs to the driver's post-tick tap)")
+            pc, cur = obs
+            obs_out = obs_device.tap_tick_local(pc, cur, state)
+        return state, want, bjob_vec, ret_rows, ret_valid, obs_out
+
+    def _tick(self, state: SimState, packed_arrivals, emit_io: bool,
+              tick_indexed: bool = False, params=None, phase_limit=None,
+              obs=None):
+        """The tick body. ``emit_io=False`` (the batch/scan path) skips the
+        TickIO packing work when borrowing doesn't need it — the return-slot
+        argsort is per-tick cost the headline config shouldn't pay.
+        ``tick_indexed``: ``packed_arrivals`` is this tick's
+        (rows [C, K, NF], counts [C]) TickArrivals slice instead of the
+        whole stream. ``params``: the PolicyParams pytree selecting and
+        parameterizing the scheduling pass (None = this engine's
+        config-derived defaults, baked as constants). ``phase_limit``:
+        static int truncating the body after the first N phases
+        (obs.profile.TICK_PHASES order) — the profile plane's ablation
+        hook (``run_prefix``/tools/profile_capture.py); None runs all
+        phases (obs.profile.TICK_PHASES has the authoritative count).
+        Every phase is wrapped in a ``jax.named_scope`` so profiler
+        captures attribute device time per phase (trace-time metadata
+        only — bitwise invisible to the compiled program's results).
+
+        ``obs``: an optional ``(MetricsBuffer, TapCursor)`` pair. On the
+        fused TERMINAL path the metrics tap runs as the kernel epilogue
+        and the finished ``(mbuf, cursor)`` returns as the third element;
+        otherwise the third element is None and the driver applies the
+        ordinary post-tick tap (same code either way — obs.device splits
+        ``tap_tick`` into the halves the kernel boundary needs).
+
+        Returns ``(state, io, obs_out)``."""
+        cfg = self.cfg
+        if params is None:
+            params = self._default_params
+        phase_on = (lambda k: True) if phase_limit is None else \
+            (lambda k: k <= phase_limit)
+        t = state.t + cfg.tick_ms
+        node_dt = state.node_free.dtype
+        node_narrow = node_dt != jnp.int32
+        terminal = self.prefix_terminal()
+        emit_returns = cfg.borrowing or emit_io
         arr_rows, arr_n = packed_arrivals
-        if phase_on(5) and self.fused_active():
+
+        # Phases 1-5 — the per-cluster prefix. With ``cfg.fused`` it runs
+        # as ONE Pallas kernel that loads each cluster block's columns
+        # once, replays ``_span_prefix`` on the VMEM-resident values, and
+        # writes each column back once (kernels/fused_tick.py) — the tick
+        # then resumes at the first cross-cluster exchange with exactly
+        # the kernel's outputs (want/bjob_vec/packed return rows).
+        # ``run_prefix`` truncations INSIDE the prefix fall back to the
+        # unfused path (a half-span is a diagnostic, not a kernel).
+        fuse = self.fused_active() and \
+            (phase_limit is None or phase_limit >= 5)
+        # simlint: ignore[purity-traced-branch] -- `fuse` is a Python bool
+        # from config + the static phase_limit argnum, never a tracer:
+        # fused-vs-unfused is an execution strategy decided before tracing
+        if fuse:
             from multi_cluster_simulator_tpu.kernels import fused_tick
-            with phase_scope("fused_span"):
-                state, want, bjob_vec = fused_tick.fused_span(
-                    self, state, arr_rows, arr_n, t, params, tick_indexed)
+            tap_in = None
+            if obs is not None and terminal:
+                mb0, cur0 = obs
+                tap_in = (obs_device.tap_pc(mb0), cur0)
+            with phase_scope("fused_prefix"):
+                state, want, bjob_vec, ret_rows, ret_valid, tap_out = \
+                    fused_tick.fused_prefix(
+                        self, state, arr_rows, arr_n, t, params,
+                        tick_indexed, emit_returns=emit_returns,
+                        obs=tap_in)
+            if tap_in is not None:
+                # the cross-cluster tap half (scalar tick count, ring
+                # rows, histogram scatter) on the kernel's tiny [C]
+                # outputs; t is the post-tick clock the dense tap reads
+                pc2, cur2, placed_d, depth = tap_out
+                obs_out = (obs_device.tap_tick_global(
+                    mb0.replace(**pc2), placed_d, depth, t, cfg.tick_ms),
+                    cur2)
+            else:
+                obs_out = None
         else:
-            state, want, bjob_vec = self._span_ingest_schedule(
-                state, arr_rows, arr_n, t, params, tick_indexed,
-                do_ingest=phase_on(4), do_schedule=phase_on(5))
+            state, want, bjob_vec, ret_rows, ret_valid, _ = \
+                self._span_prefix(state, arr_rows, arr_n, t, params,
+                                  tick_indexed, emit_returns=emit_returns,
+                                  phase_limit=phase_limit)
+            obs_out = None
+
+        if ret_rows is None:
+            C = state.arr_ptr.shape[0]
+            ret_rows = jnp.zeros((C, cfg.max_msgs, R.RF), jnp.int32)
+            ret_valid = jnp.zeros((C, cfg.max_msgs), bool)
+        # 2b. return delivery — the cross-cluster half of the completions
+        # phase (exchange gather). Runs after the whole prefix: bitwise
+        # identical to delivering before expiry/ingest/schedule because it
+        # touches ONLY ``state.borrowed``, which no prefix phase reads.
+        if cfg.borrowing and phase_on(2):
+            with phase_scope("release"):
+                state = _deliver_returns(state, ret_rows, ret_valid, self.ex)
+
         # 6. borrow matching (FIFO-family cells only: want is identically
         # False elsewhere, making the match a bitwise no-op for those cells)
         if cfg.borrowing and self.pset.has_fifo and phase_on(6):
@@ -897,7 +1022,7 @@ class Engine:
             with phase_scope("trade"):
                 state = self._trade_round(state, t, params=params)
 
-        if node_narrow:
+        if node_narrow and not terminal:
             # CHECKED, unlike the interior permutation narrows: the plan's
             # node bound is derived (physical caps, plus contract totals
             # under the trader — a buyer's virtual node holds a backlog
@@ -907,7 +1032,8 @@ class Engine:
             # node tensors have no counter of their own); it is a scalar
             # total folded into every cluster's counter — the parity and
             # bench gates assert ==0, so magnitude only matters as
-            # nonzero-ness.
+            # nonzero-ness. On a TERMINAL prefix this already happened
+            # inside ``_span_prefix`` (folded into the kernel).
             free_n, bad_f = F.narrow_store(state.node_free, node_dt)
             cap_n, bad_c = F.narrow_store(state.node_cap, node_dt)
             state = state.replace(
@@ -916,7 +1042,7 @@ class Engine:
 
         io = TickIO(borrow_want=want, borrow_job=bjob_vec,
                     ret_rows=ret_rows, ret_valid=ret_valid) if emit_io else None
-        return state.replace(t=t), io
+        return state.replace(t=t), io, obs_out
 
     # -- scan driver --
     def run(self, state: SimState, arrivals: Arrivals, n_ticks: int,
@@ -966,10 +1092,14 @@ class Engine:
 
             def body_ta(carry, x):
                 s, mb, cur = carry
-                s2 = self._tick(s, x, emit_io=False, tick_indexed=True,
-                                params=params)[0]
+                s2, _, ob = self._tick(s, x, emit_io=False,
+                                       tick_indexed=True, params=params,
+                                       obs=(mb, cur) if obs else None)
                 if obs:
-                    mb, cur = obs_device.tap_tick(mb, cur, s2, tick_ms)
+                    # fused terminal path: the tap already ran as the
+                    # kernel epilogue; otherwise the ordinary post-tick tap
+                    mb, cur = ob if ob is not None else \
+                        obs_device.tap_tick(mb, cur, s2, tick_ms)
                 return (s2, mb, cur), (st.metric_sample(s2) if record
                                        else None)
 
@@ -982,9 +1112,11 @@ class Engine:
 
         def body(carry, _):
             s, mb, cur = carry
-            s2 = self._tick(s, packed, emit_io=False, params=params)[0]
+            s2, _, ob = self._tick(s, packed, emit_io=False, params=params,
+                                   obs=(mb, cur) if obs else None)
             if obs:
-                mb, cur = obs_device.tap_tick(mb, cur, s2, tick_ms)
+                mb, cur = ob if ob is not None else \
+                    obs_device.tap_tick(mb, cur, s2, tick_ms)
             return (s2, mb, cur), (st.metric_sample(s2) if record else None)
 
         (state, mbuf, _), series = jax.lax.scan(body, (state, mbuf, cur0),
@@ -1033,10 +1165,12 @@ class Engine:
         def body(carry, x):
             s, mb, cur = carry
             r, c = x
-            s2, io = self._tick(s, (r, c), emit_io=True, tick_indexed=True,
-                                params=params)
+            s2, io, ob = self._tick(s, (r, c), emit_io=True,
+                                    tick_indexed=True, params=params,
+                                    obs=(mb, cur) if obs else None)
             if obs:
-                mb, cur = obs_device.tap_tick(mb, cur, s2, tick_ms)
+                mb, cur = ob if ob is not None else \
+                    obs_device.tap_tick(mb, cur, s2, tick_ms)
             return (s2, mb, cur), io
 
         (state, mbuf, _), io = jax.lax.scan(body, (state, mbuf, cur0),
@@ -1151,10 +1285,12 @@ class Engine:
             rows_i = jax.lax.dynamic_index_in_dim(rows, i, 0, keepdims=False)
             cnt_i = jax.lax.dynamic_index_in_dim(counts, i, 0, keepdims=False)
             sig0 = _quiescence_sig(s)
-            s2 = self._tick(s, (rows_i, cnt_i), emit_io=False,
-                            tick_indexed=True, params=params)[0]
+            s2, _, ob = self._tick(s, (rows_i, cnt_i), emit_io=False,
+                                   tick_indexed=True, params=params,
+                                   obs=(mb, cur) if obs else None)
             if obs:  # the executed tick's sample, same tap as the dense scan
-                mb, cur = obs_device.tap_tick(mb, cur, s2, cfg.tick_ms)
+                mb, cur = ob if ob is not None else \
+                    obs_device.tap_tick(mb, cur, s2, cfg.tick_ms)
             quiet = self.ex.alland(jnp.all(_quiescence_sig(s2) == sig0))
             # leap target: the clock of the next tick that must execute
             ev = jnp.minimum(

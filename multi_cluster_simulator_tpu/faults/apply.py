@@ -1,8 +1,12 @@
 """The fault phase: kill, requeue, mask, repair — one cluster per call.
 
-Runs at tick entry (core/engine.py ``_tick`` phase 1, before completions),
-vmapped over the cluster axis like every per-cluster phase. Semantics,
-each documented in PARITY.md §fault schedules:
+Runs at tick entry (core/engine.py ``_span_prefix`` phase 1, before
+completions), vmapped over the cluster axis like every per-cluster
+phase. As the opening phase of the fused per-cluster prefix it replays
+INSIDE the Pallas kernel when ``cfg.fused`` engages and faults are
+enabled (kernels/fused_tick.py: the engaged span starts at "faults") —
+same function, block-resident values, bit-identical by construction.
+Semantics, each documented in PARITY.md §fault schedules:
 
 - **Failures before completions.** A job whose ``end_t`` falls on the same
   tick its node fails is killed, not completed — the failure took the node

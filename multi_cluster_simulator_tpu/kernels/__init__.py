@@ -1,6 +1,7 @@
 """Hand-written device kernels (Pallas).
 
-One kernel so far: the fused ingest->schedule tick span
+One kernel so far: the fused per-cluster tick prefix — phases 1-5,
+faults through schedule — as one ``pallas_call``
 (``kernels/fused_tick.py``), gated by ``SimConfig.fused`` and pinned
 bit-identical to the unfused XLA tick via the interpret-mode oracle
 (ARCHITECTURE.md §fused tick kernel). simlint rule family 10
@@ -9,6 +10,6 @@ for everything under this package.
 """
 
 from multi_cluster_simulator_tpu.kernels.fused_tick import (  # noqa: F401
-    FUSED_SPAN, block_clusters, fused_span, interpret_mode, is_active,
-    provenance, span_boundary_bytes,
+    FUSED_SPAN, block_clusters, engaged_span, fused_prefix,
+    interpret_mode, is_active, provenance, span_boundary_bytes,
 )

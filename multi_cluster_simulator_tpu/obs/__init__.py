@@ -20,9 +20,9 @@ invisibility to replay:
 """
 
 from multi_cluster_simulator_tpu.obs.device import (  # noqa: F401
-    OBS_DEPTH_BUCKETS, OBS_RING, MetricsBuffer, TapCursor, cursor_of,
-    harvest, metrics_init, queue_depth, reduce_metrics, tap_leap,
-    tap_tick,
+    OBS_DEPTH_BUCKETS, OBS_RING, PC_LEAVES, MetricsBuffer, TapCursor,
+    cursor_of, harvest, metrics_init, queue_depth, reduce_metrics,
+    tap_leap, tap_pc, tap_tick, tap_tick_global, tap_tick_local,
 )
 from multi_cluster_simulator_tpu.obs.profile import (  # noqa: F401
     TICK_PHASES, annotate_dispatch, phase_scope,

@@ -181,35 +181,50 @@ def _depth_buckets(depth: jax.Array) -> jax.Array:
     return jnp.clip(jnp.where(depth > 0, b, 0), 0, OBS_DEPTH_BUCKETS - 1)
 
 
-def tap_tick(mbuf: MetricsBuffer, cur: TapCursor, state: SimState,
-             tick_ms: int) -> tuple[MetricsBuffer, TapCursor]:
-    """Accumulate one executed tick's sample — READS the post-tick state,
-    writes only the buffer + cursor (the obs-tap contract)."""
+# The buffer leaves the per-cluster tap half owns: every [C]-shaped
+# accumulator. This is the slice that rides the fused kernel as operands
+# (kernels/fused_tick.py folds ``tap_tick_local`` into the epilogue);
+# the scalar tick count, the histogram scatter, and the ring rows stay in
+# ``tap_tick_global`` outside the kernel — they are cross-cluster
+# reductions a per-cluster-blocked grid step cannot own.
+PC_LEAVES = ("placed", "arrived", "borrows", "wait_accrued", "ovf",
+             "depth_sum", "depth_max", "kills", "requeues", "fail_drops",
+             "node_down_ms")
+
+
+def tap_pc(mbuf: MetricsBuffer) -> dict:
+    """The buffer's per-cluster slice as a plain dict — the operand form
+    the fused epilogue consumes; splice back with ``mbuf.replace(**pc)``."""
+    return {k: getattr(mbuf, k) for k in PC_LEAVES}
+
+
+def tap_tick_local(pc: dict, cur: TapCursor, state: SimState):
+    """The per-cluster half of ``tap_tick``: differences the cumulative
+    state counters against the cursor and accumulates into the [C] buffer
+    leaves. READS the state, writes only ``pc`` + the cursor (the obs-tap
+    contract). Must not read ``state.t`` — inside the fused kernel's
+    epilogue the clock has not advanced yet (``_tick`` stamps it after the
+    span); everything clock-addressed lives in ``tap_tick_global``.
+    Returns ``(pc', cur', placed_d, depth)`` — the two [C] vectors the
+    global half needs for the ring/histogram writes."""
     placed_d = state.placed_total - cur.placed
     arrived_d = state.arr_ptr - cur.arrived
     lent_d = jnp.maximum(state.lent.count - cur.lent, 0)
     ovf_now = _ovf_total(state)
     depth = queue_depth(state)
-    slot = (state.t // jnp.int32(tick_ms)) % OBS_RING
-    mbuf = mbuf.replace(
-        ticks=mbuf.ticks + 1,
-        placed=mbuf.placed + placed_d,
-        arrived=mbuf.arrived + arrived_d,
-        borrows=mbuf.borrows + lent_d,
-        wait_accrued=mbuf.wait_accrued + (state.wait_total - cur.wait),
-        ovf=mbuf.ovf + (ovf_now - cur.ovf),
-        kills=mbuf.kills + (state.faults.kills - cur.kills),
-        requeues=mbuf.requeues + (state.faults.requeues - cur.requeues),
-        fail_drops=mbuf.fail_drops + (state.drops.failed - cur.fail_drops),
-        node_down_ms=mbuf.node_down_ms + (state.faults.down_ms - cur.down_ms),
-        depth_sum=mbuf.depth_sum + depth,
-        depth_max=jnp.maximum(mbuf.depth_max, depth),
-        depth_hist=mbuf.depth_hist.at[0, _depth_buckets(depth)].add(1),
-        ring_placed=mbuf.ring_placed.at[0, slot].set(
-            jnp.sum(placed_d).astype(jnp.int32)),
-        ring_depth=mbuf.ring_depth.at[0, slot].set(
-            jnp.sum(depth).astype(jnp.int32)),
-        ring_t=mbuf.ring_t.at[slot].set(state.t),
+    pc = dict(
+        placed=pc["placed"] + placed_d,
+        arrived=pc["arrived"] + arrived_d,
+        borrows=pc["borrows"] + lent_d,
+        wait_accrued=pc["wait_accrued"] + (state.wait_total - cur.wait),
+        ovf=pc["ovf"] + (ovf_now - cur.ovf),
+        depth_sum=pc["depth_sum"] + depth,
+        depth_max=jnp.maximum(pc["depth_max"], depth),
+        kills=pc["kills"] + (state.faults.kills - cur.kills),
+        requeues=pc["requeues"] + (state.faults.requeues - cur.requeues),
+        fail_drops=pc["fail_drops"] + (state.drops.failed - cur.fail_drops),
+        node_down_ms=pc["node_down_ms"]
+        + (state.faults.down_ms - cur.down_ms),
     )
     cur = TapCursor(placed=state.placed_total, arrived=state.arr_ptr,
                     lent=state.lent.count, wait=state.wait_total,
@@ -217,6 +232,41 @@ def tap_tick(mbuf: MetricsBuffer, cur: TapCursor, state: SimState,
                     kills=state.faults.kills, requeues=state.faults.requeues,
                     fail_drops=state.drops.failed,
                     down_ms=state.faults.down_ms)
+    return pc, cur, placed_d, depth
+
+
+def tap_tick_global(mbuf: MetricsBuffer, placed_d: jax.Array,
+                    depth: jax.Array, t: jax.Array,
+                    tick_ms: int) -> MetricsBuffer:
+    """The cross-cluster half of ``tap_tick``: the scalar tick count, the
+    depth histogram scatter, and the ring rows. ``t`` is the POST-tick
+    clock, passed explicitly (on the fused path the local half ran inside
+    the kernel epilogue where ``state.t`` is still the previous tick) —
+    it must equal the ``state.t`` the dense tap would read. Runs as plain
+    XLA on the tiny [C] vectors the kernel emitted; ``mbuf`` here already
+    carries the spliced-back per-cluster leaves."""
+    slot = (t // jnp.int32(tick_ms)) % OBS_RING
+    return mbuf.replace(
+        ticks=mbuf.ticks + 1,
+        depth_hist=mbuf.depth_hist.at[0, _depth_buckets(depth)].add(1),
+        ring_placed=mbuf.ring_placed.at[0, slot].set(
+            jnp.sum(placed_d).astype(jnp.int32)),
+        ring_depth=mbuf.ring_depth.at[0, slot].set(
+            jnp.sum(depth).astype(jnp.int32)),
+        ring_t=mbuf.ring_t.at[slot].set(t),
+    )
+
+
+def tap_tick(mbuf: MetricsBuffer, cur: TapCursor, state: SimState,
+             tick_ms: int) -> tuple[MetricsBuffer, TapCursor]:
+    """Accumulate one executed tick's sample — READS the post-tick state,
+    writes only the buffer + cursor (the obs-tap contract). Recomposed
+    from the two halves the fused path splits across the kernel boundary,
+    so tap-in-epilogue == post-tick tap is equality of the SAME code, not
+    of a copy (tests/test_kernels.py pins it)."""
+    pc, cur, placed_d, depth = tap_tick_local(tap_pc(mbuf), cur, state)
+    mbuf = tap_tick_global(mbuf.replace(**pc), placed_d, depth, state.t,
+                           tick_ms)
     return mbuf, cur
 
 

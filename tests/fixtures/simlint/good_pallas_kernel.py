@@ -27,3 +27,27 @@ def call(cfg, x):
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         interpret=interp,
     )(x)
+
+
+# the jaxpr-replay call-site shape (kernels/fused_tick.py): the body
+# loads each incoming ref exactly once, replays a pre-traced jaxpr on the
+# block-resident values, and stores one result per output ref — still
+# pure block indexing, still interpret threaded from config
+def _replay_body(closed, n_out, *refs):
+    ins, outs = refs[:-n_out], refs[-n_out:]
+    vals = [r[...] for r in ins]  # ONE load per ref
+    results = jax.core.eval_jaxpr(closed.jaxpr, closed.consts, *vals)
+    for o_ref, res in zip(outs, results):
+        o_ref[...] = res  # ONE store per output ref
+
+
+def call_replay(cfg, closed, templates, *args):
+    import functools
+    interp = interpret_mode(cfg)
+    return pl.pallas_call(
+        functools.partial(_replay_body, closed, len(templates)),
+        grid=(1,),
+        out_shape=[jax.ShapeDtypeStruct(t.shape, t.dtype)
+                   for t in templates],
+        interpret=interp,
+    )(*args)

@@ -1,11 +1,13 @@
 """The fused tick kernel (kernels/fused_tick.py): the Pallas
-ingest->schedule span, gated by the interpret-mode oracle, must be
-bit-identical to the unfused XLA tick across the full parity matrix —
-DELAY parity/blocked/wave+trader, FFD, FIFO+borrowing, the gavel/tesserae
-scored sweeps — composed with the compact layout, event-compressed time,
-the ragged chunk pipeline, the fault plane, the 8-device mesh, and a
-checkpoint cut inside a fused run; and the checked-narrow overflow
-counting must be preserved through the kernel path
+faults->schedule prefix (the whole per-cluster-local span, phases 1-5),
+gated by the interpret-mode oracle, must be bit-identical to the unfused
+XLA tick across the full parity matrix — DELAY parity/blocked/wave+trader,
+FFD, FIFO+borrowing, the gavel/tesserae scored sweeps — composed with the
+compact layout, event-compressed time, the ragged chunk pipeline, the
+fault plane, the 8-device mesh, the tenant axis, and a checkpoint cut
+inside a fused run; the checked-narrow overflow counting must be
+preserved through the kernel path; and the obs tap folded into the
+kernel epilogue must equal the post-tick tap bit for bit
 (ARCHITECTURE.md §fused tick kernel, PARITY.md §fused kernel)."""
 
 import dataclasses
@@ -51,12 +53,28 @@ def test_block_clusters_is_a_divisor_at_or_under_the_hint():
             assert C % bc == 0 and 1 <= bc <= max(min(C, hint), 1), (C, hint)
 
 
-def test_fused_provenance_names_the_span():
+def test_fused_provenance_names_the_engaged_span():
+    """The span is per-config: gated phases join only when engaged, so a
+    faults-off config fuses a shorter prefix rather than dead phases."""
     cfg = _fused(_cfg())
     prov = Engine(cfg).fused_provenance()
     assert prov["mode"] == "on" and prov["active"]
-    assert prov["span"] == ["ingest", "schedule"]
+    assert prov["span"] == ["release", "ingest", "schedule"]
+    assert prov["epilogue_tap"] is True  # terminal: tap folds in
     assert prov["interpret"] is True  # the CPU/CI oracle contract
+
+    faulty = _fused(_cfg(), faults=dataclasses.replace(
+        _cfg().faults, enabled=True, mttf_ms=8_000, mttr_ms=3_000))
+    assert Engine(faulty).fused_provenance()["span"] == \
+        ["faults", "release", "ingest", "schedule"]
+
+    from multi_cluster_simulator_tpu.config import TraderConfig
+    trading = _fused(_cfg(), parity=False, max_virtual_nodes=2, n_res=3,
+                     trader=TraderConfig(enabled=True,
+                                         expire_virtual_nodes=True))
+    prov_t = Engine(trading).fused_provenance()
+    assert "expire" in prov_t["span"]  # trader expiry joins the prefix
+    assert prov_t["epilogue_tap"] is False  # trade rounds follow the span
 
 
 # --------------------------------------------------------------------------
@@ -158,14 +176,16 @@ def test_fused_chunked_across_ragged_k_boundary():
 
 
 def test_fused_composes_with_faults():
-    """The fault phase (before the span) feeds kill/requeue state through
-    the kernel; generative churn must stay bit-identical fused."""
+    """The fault phase OPENS the fused span: the generative kill/requeue
+    churn replays inside the kernel body (nonzero kills on block-resident
+    state), and the run must stay bit-identical fused."""
     cfg = _cfg()
     cfg = dataclasses.replace(cfg, faults=dataclasses.replace(
         cfg.faults, enabled=True, mttf_ms=8_000, mttr_ms=3_000))
     C, n_ticks = 3, 30
     arr = _bursty_arrivals(C)
     ta = pack_arrivals_by_tick(arr, n_ticks, TICK_MS)
+    assert "faults" in Engine(_fused(cfg)).fused_provenance()["span"]
     ref = Engine(cfg).run_jit()(init_state(cfg, _specs(C)), ta, n_ticks)
     out = Engine(_fused(cfg)).run_jit()(init_state(cfg, _specs(C)), ta,
                                         n_ticks)
@@ -291,6 +311,153 @@ def test_fused_preserves_narrow_overflow_counting():
     assert CC.overflow_total(out) > 0, (
         "the 500-core rows never overflowed int8 — vacuous ovf test")
     # clamped to the dtype minimum (deterministic poison), never wrapped
+    stored = np.asarray(out.ready.f_cores)
+    assert not (stored == 500 % 256).any()
+
+
+# --------------------------------------------------------------------------
+# the obs epilogue: tap-in-kernel == post-tick tap, exact everywhere
+# --------------------------------------------------------------------------
+
+def test_fused_obs_epilogue_equals_post_tick_tap():
+    """On a terminal prefix (no borrowing/trader) the per-cluster tap half
+    runs in the kernel EPILOGUE against block-resident state; the global
+    half (ticks, rings, depth hist) follows outside. Buffer and state
+    must equal the unfused post-tick tap bit for bit — with generative
+    churn on, so the kill/requeue counters are harvested from values the
+    kernel itself produced."""
+    from tests.test_obs import _run_obs
+
+    cfg = _cfg()
+    cfg = dataclasses.replace(cfg, faults=dataclasses.replace(
+        cfg.faults, enabled=True, mttf_ms=8_000, mttr_ms=3_000))
+    C, n_ticks = 3, 30
+    ta = pack_arrivals_by_tick(_bursty_arrivals(C), n_ticks, TICK_MS)
+    eng_f = Engine(_fused(cfg))
+    assert eng_f.fused_provenance()["epilogue_tap"] is True
+    ref, mb_ref = _run_obs(Engine(cfg), init_state(cfg, _specs(C)), ta,
+                           n_ticks)
+    out, mb = _run_obs(eng_f, init_state(cfg, _specs(C)), ta, n_ticks)
+    _assert_trees_equal(ref, out)
+    _assert_trees_equal(mb_ref, mb)
+    assert int(np.asarray(mb.kills).sum()) > 0, \
+        "no kill ever reached the tap — vacuous epilogue test"
+
+
+def test_fused_obs_exact_under_time_compression():
+    """The compressed driver over the fused body taps only EXECUTED ticks
+    through the epilogue (leaps stay on the closed-form tap_leap path);
+    the harvested buffer must still equal the dense unfused driver's."""
+    from multi_cluster_simulator_tpu.obs import device as D
+    from tests.test_obs import _assert_mbuf_equal, _run_obs
+
+    cfg, arr, specs = _tc_scenarios()["delay_parity"]
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    ref, ref_ser, mb_dense = _run_obs(Engine(cfg), init_state(cfg, specs),
+                                      ta, TC_TICKS)
+    out, ser, stats, mb = jax.jit(
+        Engine(_fused(cfg)).run_compressed, static_argnums=(2,))(
+        init_state(cfg, specs), ta, TC_TICKS, None,
+        D.metrics_init(init_state(cfg, specs)))
+    _assert_trees_equal(ref, out)
+    _assert_trees_equal(ref_ser, ser)
+    _assert_mbuf_equal(mb_dense, mb)
+    assert int(np.asarray(stats.ticks_executed)) < TC_TICKS, \
+        "compression never leapt — vacuous exactness test"
+
+
+# --------------------------------------------------------------------------
+# trader config: non-terminal prefix, packed returns without borrowing
+# --------------------------------------------------------------------------
+
+def test_fused_trader_run_io_matches_unfused_events():
+    """A trading config fuses a NON-terminal prefix (trade rounds follow
+    the span; the tap stays outside), and run_io's packed return rows are
+    emitted by the kernel even with borrowing off — states and stacked
+    TickIO must both equal the unfused run."""
+    cfg, arr, specs = _tc_scenarios()["delay_wave_trader"]
+    cfg = dataclasses.replace(
+        cfg, record_metrics=False,
+        trader=dataclasses.replace(cfg.trader, expire_virtual_nodes=True))
+    span = Engine(_fused(cfg)).fused_provenance()["span"]
+    assert span == ["release", "expire", "ingest", "schedule"]
+    ta = pack_arrivals_by_tick(arr, TC_TICKS, cfg.tick_ms)
+    s0 = init_state(cfg, specs)
+    ref_s, ref_io = Engine(cfg).run_io_jit()(s0, ta.rows[:TC_TICKS],
+                                             ta.counts[:TC_TICKS])
+    out_s, out_io = Engine(_fused(cfg)).run_io_jit()(s0, ta.rows[:TC_TICKS],
+                                                     ta.counts[:TC_TICKS])
+    _assert_trees_equal(ref_s, out_s)
+    _assert_trees_equal(ref_io, out_io)
+    assert int(np.asarray(ref_s.placed_total).sum()) > 0
+
+
+# --------------------------------------------------------------------------
+# the tenant axis: vmap over the fused body, one executable
+# --------------------------------------------------------------------------
+
+def test_fused_tenancy_run_io_composes_one_compile():
+    """The vmapped tenant axis over the fused tick body: every cell of
+    the fused batch equals the unfused batch bit for bit, and distinct
+    TenantParams still share ONE executable — the cache pin survives a
+    pallas_call in the scan body."""
+    from multi_cluster_simulator_tpu import tenancy
+
+    cfg, specs = _cfg(), _specs(3)
+    T, n_ticks = 2, 10
+    tas = []
+    for i in range(T):
+        arr = uniform_stream(3, 12, n_ticks * cfg.tick_ms, 24, 18_000,
+                             3 * cfg.tick_ms, seed=7 + i)
+        tas.append(pack_arrivals_by_tick(arr, n_ticks, cfg.tick_ms))
+    k = max(np.asarray(t.rows).shape[2] for t in tas)
+    sta = tenancy.stack_tick_arrivals(
+        [tenancy.pad_tick_arrivals(t, k) for t in tas])
+
+    tb_u = tenancy.TenantBatch(cfg, specs)
+    tb_f = tenancy.TenantBatch(_fused(cfg), specs)
+    tp = tb_u.default_params(T)
+    ref, ref_io = tb_u.run_io_fn(donate=False)(
+        tb_u.init_stacked(tp), sta.rows, sta.counts, tp)
+    fn = tb_f.run_io_fn(donate=False)
+    out, io = fn(tb_f.init_stacked(tp), sta.rows, sta.counts, tp)
+    _assert_trees_equal(ref, out)
+    _assert_trees_equal(ref_io, io)
+    assert fn._jit._cache_size() == 1, \
+        "tenant knobs are data, not programs — even through the kernel"
+
+
+def test_fused_narrow_overflow_composes_with_faults():
+    """The undersized-plan ovf pin through the WIDENED span: with churn
+    on, the fault phase's kill/requeue writes also run against the int8
+    queue inside the kernel — counting stays bit-identical and the
+    checked-narrow store still never wraps."""
+    from multi_cluster_simulator_tpu.core.state import Arrivals
+
+    cfg = _cfg()
+    cfg = dataclasses.replace(cfg, faults=dataclasses.replace(
+        cfg.faults, enabled=True, mttf_ms=8_000, mttr_ms=3_000))
+    C, A = 1, 4
+    arr = Arrivals(
+        t=np.asarray([[1_500, 2_500, 3_500, 4_500]], np.int32),
+        id=np.arange(A, dtype=np.int32).reshape(1, A),
+        cores=np.asarray([[500, 2, 500, 2]], np.int32),
+        mem=np.full((1, A), 100, np.int32),
+        gpu=np.zeros((1, A), np.int32),
+        dur=np.full((1, A), 5_000, np.int32),
+        n=np.full((1,), A, np.int32))
+    plan = CC.derive_plan(cfg, _specs(C), arrivals=None)
+    undersized = dataclasses.replace(
+        plan, queue=tuple((n, "int8" if n == "cores" else dt)
+                          for n, dt in plan.queue))
+    ta = pack_arrivals_by_tick(arr, 10, TICK_MS)
+    ref = Engine(cfg).run_jit()(
+        init_state(cfg, _specs(C), plan=undersized), ta, 10)
+    out = Engine(_fused(cfg)).run_jit()(
+        init_state(cfg, _specs(C), plan=undersized), ta, 10)
+    _assert_trees_equal(ref, out)
+    assert CC.overflow_total(out) > 0, (
+        "the 500-core rows never overflowed int8 — vacuous ovf test")
     stored = np.asarray(out.ready.f_cores)
     assert not (stored == 500 % 256).any()
 

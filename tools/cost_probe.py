@@ -173,16 +173,18 @@ def probe_fused_span(cfg, n_ticks, specs, arr, plan):
     fused full-tick wall as the same scanned-run timing the layout rows
     use.
 
-    Under XLA the ingest->schedule span is separate computations whose
-    queue/runset/node columns cross a buffer boundary PER PHASE; fused,
-    each column crosses once (one load + one store). The instrument makes
-    that concrete: each span phase is compiled as its own executable and
-    its argument+output bytes summed (``unfused_total`` — the per-phase
-    boundary traffic), against the ONE fused-span executable's
-    argument+output bytes (``fused``). The gate (``_check``) requires the
-    fused number strictly lower. ``plan`` should be the layout the
-    comparison rows measured (compact when available — the acceptance
-    bar is "below the compact unfused tick", not the easy wide one)."""
+    Under XLA the per-cluster prefix (the config's engaged span of
+    faults->release->expire->ingest->schedule) is separate computations
+    whose queue/runset/node columns cross a buffer boundary PER PHASE;
+    fused, each column crosses once (one load + one store). The
+    instrument makes that concrete: each engaged phase is compiled as its
+    own executable and its argument+output bytes summed
+    (``unfused_total`` — the per-phase boundary traffic), against the ONE
+    fused-prefix executable's argument+output bytes (``fused``). The gate
+    (``_check``) requires the fused number strictly lower. ``plan``
+    should be the layout the comparison rows measured (compact when
+    available — the acceptance bar is "below the compact unfused tick",
+    not the easy wide one)."""
     import dataclasses
 
     import jax
@@ -344,12 +346,14 @@ def main(argv=None):
                          "per-shape reduction (both, default), wide only "
                          "(off), compact only (on)")
     ap.add_argument("--fused", choices=("off", "on"), default="off",
-                    help="also measure the fused ingest->schedule span "
-                         "(kernels/fused_tick.py) on each shape: per-phase "
-                         "executable boundary bytes vs the ONE fused-span "
-                         "executable's, plus the fused full-tick wall — "
-                         "exits nonzero unless the fused span streams "
-                         "strictly fewer bytes and places identical work")
+                    help="also measure the fused per-cluster prefix "
+                         "(kernels/fused_tick.py, the engaged span of "
+                         "phases faults->schedule) on each shape: "
+                         "per-phase executable boundary bytes vs the ONE "
+                         "fused-prefix executable's, plus the fused "
+                         "full-tick wall — exits nonzero unless the fused "
+                         "prefix streams strictly fewer bytes and places "
+                         "identical work")
     args = ap.parse_args(argv)
     # same discipline as bench.py's quick-vs-full results files: smoke
     # shapes must never clobber the committed full-scale record (shared

@@ -146,14 +146,28 @@ def phase_table(cfg, specs, arrivals, n_ticks: int, repeats: int = 3):
     return rows, full
 
 
-def fusion_ranking(rows):
+def fusion_ranking(rows, span=()):
     """The machine-readable fusion-candidate ranking: tick phases ordered
-    by wall share, each with its ablation bytes delta — the recorded
-    provenance behind kernels/fused_tick.FUSED_SPAN's phase choice (the
-    top contiguous per-cluster-local span), so the choice is a measured
-    artifact, not folklore."""
-    cand = [r for r in rows if not r["phase"].startswith("(")]
+    by wall share, each with its ablation bytes delta and whether it sits
+    inside the engaged fused prefix — the recorded provenance behind
+    kernels/fused_tick.FUSED_SPAN's phase choice (the whole per-cluster-
+    local prefix, phases 1-5), so the choice is a measured artifact, not
+    folklore. Phases OUTSIDE the span are the collective seams (borrow/
+    snapshot/trade): their bytes deltas are what the fusion boundary
+    still pays per tick, surfaced separately by ``seam_bytes``."""
+    cand = [dict(r, in_fused_prefix=r["phase"] in span) for r in rows
+            if not r["phase"].startswith("(")]
     return sorted(cand, key=lambda r: -r["fraction"])
+
+
+def seam_bytes(rows, span):
+    """Per-phase ablation bytes of the phases left OUTSIDE the fused
+    prefix — the cross-cluster exchange seams the kernel boundary was
+    drawn at. The fused prefix collapses its interior boundaries; these
+    are the ones that remain (kernels.span_boundary_bytes measures the
+    collapsed side of the same ledger)."""
+    return {r["phase"]: r["prefix_bytes_delta"] for r in rows
+            if not r["phase"].startswith("(") and r["phase"] not in span}
 
 
 def main():
@@ -171,11 +185,13 @@ def main():
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the jax.profiler capture; only the table")
     ap.add_argument("--fused", choices=("off", "on", "auto"), default="off",
-                    help="profile the engine with the fused ingest->"
-                         "schedule kernel engaged (kernels/fused_tick.py; "
-                         "the resolved provenance lands in the table JSON "
-                         "either way). Ablation prefixes that truncate "
-                         "INSIDE the span fall back to the unfused body")
+                    help="profile the engine with the fused per-cluster "
+                         "prefix kernel engaged (kernels/fused_tick.py, "
+                         "phases faults->schedule; the resolved per-config "
+                         "span lands in the table JSON either way). "
+                         "Ablation prefixes that truncate INSIDE the span "
+                         "fall back to the unfused body — the per-phase "
+                         "columns stay honest")
     args = ap.parse_args()
 
     import dataclasses
@@ -206,7 +222,9 @@ def main():
         print("profile_capture: per-phase table empty or degenerate",
               file=sys.stderr)
         return 1
-    ranking = fusion_ranking(rows)
+    span = fused_prov.get("span", [])
+    ranking = fusion_ranking(rows, span)
+    seams = seam_bytes(rows, span)
     width = max(len(r["phase"]) for r in rows)
     print(f"{'phase':{width}s}  ms/tick   cum      frac   ablation MB")
     for r in rows:
@@ -216,7 +234,13 @@ def main():
               f"{r['cum_ms_per_tick']:7.4f}  {r['fraction']:6.1%}  {mb}")
     print("# fusion candidates (wall share desc): "
           + ", ".join(f"{r['phase']}={r['fraction']:.1%}"
-                      for r in ranking[:4]), file=sys.stderr)
+                      + ("*" if r["in_fused_prefix"] else "")
+                      for r in ranking[:4])
+          + "  (* = inside the engaged fused prefix)", file=sys.stderr)
+    print("# collective seams outside the prefix: "
+          + (", ".join(f"{k}={v / 1e6:.2f}MB" if v is not None else f"{k}=-"
+                       for k, v in seams.items()) or "(none)"),
+          file=sys.stderr)
 
     # ---- profiler trace around one full-tick run ------------------------
     artifacts = []
@@ -248,6 +272,7 @@ def main():
                    "quick": args.quick, "full_ms_per_tick": round(full, 4),
                    "fused": fused_prov,
                    "phases": rows, "fusion_ranking": ranking,
+                   "collective_seam_bytes": seams,
                    "trace_artifacts": artifacts}, f, indent=2)
     print(f"# table: {table_path}", file=sys.stderr)
     return 0

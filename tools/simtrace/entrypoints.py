@@ -99,6 +99,26 @@ def _build_run():
                  static_argnums=(2,), pick_state_out=lambda o: o)
 
 
+def _build_run_fused():
+    # the fused per-cluster prefix (kernels/fused_tick.py, phases
+    # faults->schedule) through the batch driver: pallas_call
+    # (interpret=True) on the CPU audit host. The audits must hold
+    # THROUGH the kernel call site — one compile across variant values,
+    # donation honored around the kernel's operand/result buffers — and
+    # the byte budget pins the fused executable's boundary at the audit
+    # shape, so a seam regression in the kernel surfaces here too
+    from multi_cluster_simulator_tpu.core.engine import Engine
+    cfg, specs = _quick_cfg(fused="on", fused_block=2), _specs()
+    eng = Engine(cfg)
+    fn = eng.run_jit(donate=True)
+
+    def fresh(v):
+        return (_fresh_state(cfg, specs), _ticks(v, cfg=cfg), T)
+
+    return Built(fn=fn, fresh_args=fresh, donated=(0,),
+                 static_argnums=(2,), pick_state_out=lambda o: o)
+
+
 def _build_run_io():
     from multi_cluster_simulator_tpu.core.engine import Engine
     cfg, specs = _quick_cfg(), _specs()
@@ -263,6 +283,9 @@ def _build_tenancy_run_io():
 ENTRIES = [
     EntryPoint("engine.run", _build_run,
                description=f"run_jit(donate) C={C} T={T} K<={KPAD} compact"),
+    EntryPoint("engine.run_fused", _build_run_fused,
+               description=f"run_jit(donate) fused prefix interpret "
+                           f"C={C} bc=2 T={T} K<={KPAD}"),
     EntryPoint("engine.run_io", _build_run_io,
                description=f"run_io_jit(donate) C={C} T={T} K<={KPAD}"),
     EntryPoint("engine.run_compressed", _build_run_compressed,
